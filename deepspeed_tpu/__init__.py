@@ -1,0 +1,61 @@
+"""deepspeed_tpu — a TPU-native training/inference framework with the
+capabilities of DeepSpeed (reference 0.14.3), built on JAX/XLA/Pallas.
+
+Top-level API parity (reference ``deepspeed/__init__.py``):
+- ``initialize(...)`` -> ``(engine, optimizer, dataloader, lr_scheduler)``
+- ``init_inference(...)`` -> inference engine
+- ``deepspeed_tpu.comm`` as the distributed façade
+- ``zero.Init`` for sharded model construction
+"""
+
+from . import comm
+from .accelerator import get_accelerator
+from .runtime.config import DeepSpeedConfig
+from .utils import groups, logger
+from .version import __version__
+
+# populated lazily to keep import light until the engine lands
+_ENGINE_EXPORTS = {}
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               mesh=None,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               config_params=None,
+               **kwargs):
+    """Build a training engine. Reference: ``deepspeed/__init__.py:70``.
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)``.
+    """
+    from .runtime.engine import initialize as _initialize
+
+    return _initialize(args=args, model=model, optimizer=optimizer, model_parameters=model_parameters,
+                       training_data=training_data, lr_scheduler=lr_scheduler, mesh=mesh, mpu=mpu,
+                       dist_init_required=dist_init_required, collate_fn=collate_fn,
+                       config=config if config is not None else config_params, **kwargs)
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Build an inference engine. Reference: ``deepspeed/inference/engine.py:39``."""
+    from .inference.engine import init_inference as _init_inference
+
+    return _init_inference(model=model, config=config, **kwargs)
+
+
+def __getattr__(name):
+    # Lazy submodule access: deepspeed_tpu.zero, .moe, .pipe, .ops, ...
+    import importlib
+
+    lazy = {"zero", "moe", "pipe", "sequence", "ops", "models", "inference", "checkpoint", "monitor", "profiling",
+            "elasticity", "compression", "autotuning", "module_inject", "launcher", "runtime"}
+    if name in lazy:
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

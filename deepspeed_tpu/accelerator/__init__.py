@@ -1,0 +1,6 @@
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator, is_current_accelerator_supported
+from .tpu_accelerator import TPU_Accelerator
+
+__all__ = ["DeepSpeedAccelerator", "TPU_Accelerator", "get_accelerator", "set_accelerator",
+           "is_current_accelerator_supported"]

@@ -1,0 +1,103 @@
+"""Accelerator abstraction.
+
+Parity target: reference ``accelerator/abstract_accelerator.py:10``
+(``DeepSpeedAccelerator``, ~70 abstract methods). The JAX runtime already
+hides most device differences, so the TPU abstraction keeps the *query*
+surface (names, counts, memory, dtype support, RNG, synchronization,
+communication backend name) and drops torch-specific stream/event plumbing
+— XLA owns scheduling. Methods that can't map to the SPMD model raise
+``NotImplementedError`` with an explanation rather than silently lying.
+"""
+
+import abc
+from typing import List
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # --- identity ---
+    @abc.abstractmethod
+    def is_synchronized_device(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    # --- RNG ---
+    @abc.abstractmethod
+    def manual_seed(self, seed: int):
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self):
+        ...
+
+    # --- memory ---
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None) -> int:
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None) -> int:
+        ...
+
+    # --- dtype support ---
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self) -> List:
+        ...
+
+    # --- execution ---
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def empty_cache(self):
+        ...
+
+    # --- profiler ranges (reference: nvtx via accelerator) ---
+    def range_push(self, msg: str):
+        pass
+
+    def range_pop(self):
+        pass
+
+    # --- op builder discovery (reference: op_builder dir per vendor) ---
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops"

@@ -1,0 +1,35 @@
+"""Accelerator selection.
+
+Reference: ``accelerator/real_accelerator.py:51-120`` — env override
+(``DS_ACCELERATOR``) then import-probing. Here the JAX platform list plays
+the probe role; TPU and CPU both map onto ``TPU_Accelerator`` (the CPU
+path exists so the full framework runs on the simulated multi-device CPU
+mesh used by tests).
+"""
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .tpu_accelerator import TPU_Accelerator
+
+_ACCELERATOR: Optional[DeepSpeedAccelerator] = None
+
+
+def get_accelerator() -> DeepSpeedAccelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        name = os.environ.get("DS_ACCELERATOR", "tpu")
+        if name not in ("tpu", "cpu", "xla"):
+            raise ValueError(f"DS_ACCELERATOR={name} unsupported; this framework targets tpu (cpu simulates it)")
+        _ACCELERATOR = TPU_Accelerator()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: DeepSpeedAccelerator):
+    global _ACCELERATOR
+    _ACCELERATOR = accel
+
+
+def is_current_accelerator_supported() -> bool:
+    return True

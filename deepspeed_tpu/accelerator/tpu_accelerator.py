@@ -1,0 +1,146 @@
+"""TPU (and CPU-simulated-TPU) accelerator.
+
+Reference analogue: ``accelerator/cuda_accelerator.py``. Backed by the JAX
+runtime: device queries via ``jax.devices()``, memory via
+``device.memory_stats()``, RNG via a process-global seed feeding
+``jax.random`` keys, profiler ranges via ``jax.profiler``.
+"""
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TPU_Accelerator(DeepSpeedAccelerator):
+    def __init__(self):
+        super().__init__()
+        self._name = "tpu"
+        self._communication_backend_name = "xla"  # ICI within slice, DCN across
+        self._seed: Optional[int] = None
+
+    # --- identity ---
+    def is_synchronized_device(self) -> bool:
+        return False  # dispatch is async; block_until_ready to sync
+
+    def device_name(self, device_index=None) -> str:
+        return "tpu" if device_index is None else f"tpu:{device_index}"
+
+    def device_count(self) -> int:
+        return jax.device_count()
+
+    def local_device_count(self) -> int:
+        return jax.local_device_count()
+
+    def current_device(self):
+        return jax.devices()[0]
+
+    def current_device_name(self) -> str:
+        d = jax.devices()[0]
+        return f"{d.platform}:{d.id}"
+
+    def communication_backend_name(self) -> str:
+        return self._communication_backend_name
+
+    def is_available(self) -> bool:
+        try:
+            return len(jax.devices()) > 0
+        except Exception:
+            return False
+
+    def device_kind(self) -> str:
+        return jax.devices()[0].device_kind
+
+    # --- RNG ---
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+
+    def manual_seed_all(self, seed: int):
+        self.manual_seed(seed)
+
+    def initial_seed(self):
+        return self._seed if self._seed is not None else 0
+
+    def default_generator(self, device_index: int = 0):
+        return jax.random.PRNGKey(self.initial_seed())
+
+    # --- memory ---
+    def _stats(self, device_index=None) -> dict:
+        devs = jax.local_devices()
+        d = devs[device_index or 0] if device_index is not None else devs[0]
+        try:
+            return d.memory_stats() or {}
+        except Exception:
+            return {}
+
+    def memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("peak_bytes_in_use", 0))
+
+    def reset_peak_memory_stats(self, device_index=None):
+        pass  # XLA exposes no reset; peak is monotonic per process
+
+    def total_memory(self, device_index=None) -> int:
+        return int(self._stats(device_index).get("bytes_limit", 0))
+
+    def available_memory(self, device_index=None) -> int:
+        s = self._stats(device_index)
+        return int(s.get("bytes_limit", 0)) - int(s.get("bytes_in_use", 0))
+
+    def memory_stats(self, device_index=None) -> dict:
+        return self._stats(device_index)
+
+    # --- dtype support ---
+    def is_bf16_supported(self) -> bool:
+        return True  # bf16 is the native TPU matmul dtype
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def is_triton_supported(self) -> bool:
+        return False
+
+    def supported_dtypes(self) -> List:
+        dtypes = [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8, jnp.int32]
+        try:
+            dtypes += [jnp.float8_e4m3fn, jnp.float8_e5m2]
+        except AttributeError:
+            pass
+        return dtypes
+
+    # --- execution ---
+    def synchronize(self, device_index=None):
+        (jnp.zeros(()) + 0).block_until_ready()
+
+    def empty_cache(self):
+        # XLA owns the allocator; nearest analogue is freeing donated buffers,
+        # which happens automatically. Provided for API parity.
+        pass
+
+    def range_push(self, msg: str):
+        self._trace_ctx = jax.profiler.TraceAnnotation(msg)
+        self._trace_ctx.__enter__()
+
+    def range_pop(self):
+        ctx = getattr(self, "_trace_ctx", None)
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+            self._trace_ctx = None
+
+    # --- graph capture (reference: CUDA graphs; TPU: jit IS the graph) ---
+    def device_supports_graphs(self) -> bool:
+        return True
+
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, **kwargs):
+        raise NotImplementedError("On TPU, wrap the function in jax.jit instead of graph capture")
+
+    def on_accelerator(self, tensor) -> bool:
+        return isinstance(tensor, jax.Array)

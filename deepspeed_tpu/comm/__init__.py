@@ -1,0 +1,21 @@
+"""``deepspeed_tpu.comm as dist`` — the communication façade.
+
+Host-level ops (rendezvous, rank/world, eager collectives with telemetry)
+come from ``comm.py``; in-jit per-device collectives over mesh axes come
+from ``collectives.py`` and are re-exported here under ``injit_*``-free
+names via the ``collectives`` submodule.
+"""
+
+from .comm import (all_gather_into_tensor, all_gather_object, all_reduce, all_to_all_single, barrier, broadcast,
+                   comms_logger, configure, destroy_process_group, get_all_ranks_from_group, get_local_rank, get_rank,
+                   get_world_group, get_world_size, init_distributed, is_initialized, log_summary, monitored_barrier,
+                   new_group, reduce_scatter_tensor)
+from .reduce_op import ReduceOp
+from . import collectives
+
+__all__ = [
+    "init_distributed", "is_initialized", "get_rank", "get_world_size", "get_local_rank", "barrier", "all_reduce",
+    "all_gather_into_tensor", "reduce_scatter_tensor", "all_to_all_single", "broadcast", "all_gather_object",
+    "log_summary", "configure", "comms_logger", "ReduceOp", "collectives", "new_group", "get_world_group",
+    "monitored_barrier", "get_all_ranks_from_group", "destroy_process_group",
+]

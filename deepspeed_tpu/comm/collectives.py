@@ -1,0 +1,126 @@
+"""In-jit collectives over named mesh axes.
+
+These are the compute-path primitives: torch.distributed-shaped functions
+(parity with reference ``deepspeed/comm/comm.py``: ``all_reduce`` :483,
+``all_gather_into_tensor`` :297, ``reduce_scatter_tensor`` :280,
+``all_to_all_single`` :331) expressed as ``jax.lax`` collectives. They must
+be called from inside ``shard_map`` (or a ``pmap``-like context) where the
+named axis is bound; XLA lowers them onto ICI/DCN. There are no group
+handles — a "group" is a mesh axis name or tuple of names.
+"""
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .reduce_op import ReduceOp
+
+AxisName = Union[str, Sequence[str]]
+
+
+def _psum_like(tensor, axis_name: AxisName, op: ReduceOp):
+    if op in (ReduceOp.SUM, ReduceOp.AVG):
+        out = lax.psum(tensor, axis_name)
+        if op == ReduceOp.AVG:
+            out = out / lax.psum(jnp.ones((), dtype=tensor.dtype), axis_name)
+        return out
+    if op == ReduceOp.MAX:
+        return lax.pmax(tensor, axis_name)
+    if op == ReduceOp.MIN:
+        return lax.pmin(tensor, axis_name)
+    if op == ReduceOp.PRODUCT:
+        return jnp.exp(lax.psum(jnp.log(tensor), axis_name))
+    raise NotImplementedError(f"ReduceOp {op} not supported on TPU collectives")
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
+    """Reference ``comm.py:483``. Sum (or max/min/avg) across the axis."""
+    return _psum_like(tensor, group, op)
+
+
+def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "tensor"):
+    """Reference ``comm.py:500`` — the TP-inference row-parallel reduce."""
+    return _psum_like(tensor, group, op)
+
+
+def all_gather_into_tensor(tensor, group: AxisName = "data", axis: int = 0, tiled: bool = True):
+    """Gather shards along ``axis`` from every member; result is the
+    concatenation (``tiled=True``, torch semantics) or stacked (False)."""
+    return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
+
+
+def all_gather(tensor, group: AxisName = "data", axis: int = 0):
+    return lax.all_gather(tensor, group, axis=axis, tiled=True)
+
+
+def reduce_scatter_tensor(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data", axis: int = 0):
+    """Reference ``comm.py:280``. Sum across members, scatter along ``axis``."""
+    if op not in (ReduceOp.SUM, ReduceOp.AVG):
+        raise NotImplementedError("reduce_scatter supports SUM/AVG")
+    out = lax.psum_scatter(tensor, group, scatter_dimension=axis, tiled=True)
+    if op == ReduceOp.AVG:
+        out = out / lax.psum(jnp.ones((), dtype=out.dtype), group)
+    return out
+
+
+def all_to_all_single(tensor, group: AxisName = "seq", split_axis: int = 0, concat_axis: int = 0):
+    """Reference ``comm.py:331``. Split ``split_axis`` into group-size chunks,
+    exchange chunk i with member i, concatenate received chunks on
+    ``concat_axis``."""
+    return lax.all_to_all(tensor, group, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
+
+
+def all_to_all(output_unused, tensor, group: AxisName = "seq"):
+    return all_to_all_single(tensor, group)
+
+
+def broadcast(tensor, src: int = 0, group: AxisName = "data"):
+    """Broadcast the value held by member ``src`` of the axis to all members."""
+    idx = lax.axis_index(group)
+    masked = jnp.where(idx == src, tensor, jnp.zeros_like(tensor))
+    return lax.psum(masked, group)
+
+
+def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
+    """All members get the reduction; non-dst members keep their input
+    (matches torch.reduce observable state on dst)."""
+    reduced = _psum_like(tensor, group, op)
+    idx = lax.axis_index(group)
+    return jnp.where(idx == dst, reduced, tensor)
+
+
+def ppermute(tensor, perm, group: AxisName = "pipe"):
+    return lax.ppermute(tensor, group, perm)
+
+
+def send_recv_ring(tensor, group: AxisName = "pipe", shift: int = 1):
+    """Ring shift: member i's tensor goes to member (i+shift) % n."""
+    n = lax.psum(1, group)
+    # static size needed: n is traced under shard_map only if axis unbound;
+    # callers inside shard_map get a concrete python int via axis_env.
+    size = jax.core.get_axis_env_size(group) if hasattr(jax.core, "get_axis_env_size") else None
+    if size is None:
+        try:
+            size = lax.axis_size(group)
+        except Exception:
+            size = n
+    perm = [(i, (i + shift) % size) for i in range(size)]
+    return lax.ppermute(tensor, group, perm)
+
+
+def axis_rank(group: AxisName):
+    return lax.axis_index(group)
+
+
+def axis_size(group: AxisName) -> int:
+    try:
+        return lax.axis_size(group)
+    except Exception:
+        return lax.psum(1, group)
+
+
+def barrier(group: Optional[AxisName] = None):
+    """In-jit barrier is meaningless (XLA orders ops); no-op for parity."""
+    return None

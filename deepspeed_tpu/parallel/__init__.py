@@ -1,0 +1,5 @@
+from .mesh import ALL_AXES, MeshTopology, get_mesh_topology, initialize_mesh, reset_mesh
+from .topology import (PipeDataParallelTopology, PipeModelDataParallelTopology, PipelineParallelGrid, ProcessTopology)
+
+__all__ = ["MeshTopology", "initialize_mesh", "get_mesh_topology", "reset_mesh", "ALL_AXES", "ProcessTopology",
+           "PipeDataParallelTopology", "PipeModelDataParallelTopology", "PipelineParallelGrid"]

@@ -1,0 +1,166 @@
+"""Device mesh construction and axis bookkeeping.
+
+This is the TPU-native replacement for the reference's process-group layer
+(``deepspeed/utils/groups.py`` + ``runtime/pipe/topology.py``): instead of
+building NCCL process groups per parallel dimension, we build ONE
+``jax.sharding.Mesh`` with named axes and express every "group" as a mesh
+axis (or tuple of axes). XLA then lowers collectives onto ICI/DCN along
+those axes.
+
+Axes (sizes from ``MeshConfig``):
+- ``data``    — pure data parallelism (replica groups)
+- ``fsdp``    — ZeRO param/optimizer sharding axis (stage>0). When ZeRO is
+                on and ``fsdp == 1``, the engine folds ``data`` into the
+                sharding axis, matching the reference's "ZeRO over the DP
+                group" semantics.
+- ``tensor``  — tensor (megatron-style) model parallelism
+- ``pipe``    — pipeline stages
+- ``expert``  — MoE expert parallelism (reference ``groups.py:114``)
+- ``seq``     — Ulysses sequence parallelism (reference ``groups.py:464``)
+- ``context`` — ring-attention context parallelism (superset of reference)
+"""
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..runtime.config import MeshConfig
+from ..utils.logging import logger
+from .topology import ProcessTopology
+
+ALL_AXES = ("pipe", "data", "fsdp", "expert", "seq", "context", "tensor")
+
+
+def _resolve_axis_sizes(cfg: MeshConfig, n_devices: int) -> Dict[str, int]:
+    sizes = {a: getattr(cfg, a) for a in ALL_AXES}
+    wildcard = [a for a, s in sizes.items() if s == -1]
+    if len(wildcard) > 1:
+        raise ValueError(f"At most one mesh axis may be -1, got {wildcard}")
+    fixed = 1
+    for a, s in sizes.items():
+        if s != -1:
+            if s < 1:
+                raise ValueError(f"Mesh axis {a} must be >=1 or -1, got {s}")
+            fixed *= s
+    if wildcard:
+        if n_devices % fixed != 0:
+            raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
+        sizes[wildcard[0]] = n_devices // fixed
+    else:
+        total = fixed
+        if total != n_devices:
+            raise ValueError(f"Mesh axes product {total} != device count {n_devices}")
+    return sizes
+
+
+class MeshTopology:
+    """Owns the global ``jax.sharding.Mesh`` and answers axis-rank queries."""
+
+    def __init__(self, config: Optional[MeshConfig] = None, devices: Optional[Sequence] = None):
+        self.config = config or MeshConfig()
+        devices = list(devices if devices is not None else jax.devices())
+        self.n_devices = len(devices)
+        self.axis_sizes = _resolve_axis_sizes(self.config, self.n_devices)
+        order = list(self.config.axis_order)
+        if sorted(order) != sorted(ALL_AXES):
+            raise ValueError(f"axis_order must be a permutation of {ALL_AXES}, got {order}")
+        self.axis_order = order
+        shape = [self.axis_sizes[a] for a in order]
+        device_grid = self._arrange_devices(devices, shape)
+        self.mesh = Mesh(device_grid, axis_names=tuple(order))
+        # Pure-rank topology mirror for coordinate math without devices.
+        self.topology = ProcessTopology(order, shape)
+        logger.info(f"MeshTopology: axes={dict(zip(order, shape))} over {self.n_devices} devices")
+
+    @staticmethod
+    def _arrange_devices(devices, shape):
+        try:
+            from jax.experimental import mesh_utils
+
+            if devices and devices[0].platform == "tpu":
+                # Respect ICI physical topology on real TPU slices.
+                return mesh_utils.create_device_mesh(shape, devices=devices)
+        except Exception as e:  # pragma: no cover - only on exotic topologies
+            logger.warning(f"mesh_utils.create_device_mesh failed ({e}); falling back to reshape")
+        return np.array(devices).reshape(shape)
+
+    # ---- axis sizes ----
+    def axis_size(self, axis: str) -> int:
+        return self.axis_sizes.get(axis, 1)
+
+    @property
+    def data_parallel_size(self) -> int:
+        # ZeRO shards live on fsdp but each fsdp shard still sees distinct data.
+        return self.axis_size("data") * self.axis_size("fsdp")
+
+    @property
+    def sharding_size(self) -> int:
+        return self.axis_size("fsdp")
+
+    @property
+    def model_parallel_size(self) -> int:
+        return self.axis_size("tensor")
+
+    @property
+    def pipe_parallel_size(self) -> int:
+        return self.axis_size("pipe")
+
+    @property
+    def expert_parallel_size(self) -> int:
+        return self.axis_size("expert")
+
+    @property
+    def sequence_parallel_size(self) -> int:
+        return self.axis_size("seq")
+
+    @property
+    def context_parallel_size(self) -> int:
+        return self.axis_size("context")
+
+    # ---- shardings ----
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    @property
+    def batch_axes(self):
+        """Mesh axes over which the global batch is split."""
+        axes = tuple(a for a in ("data", "fsdp") if self.axis_size(a) > 1)
+        return axes if axes else ("data",)
+
+    def batch_sharding(self) -> NamedSharding:
+        return self.sharding(self.batch_axes)
+
+    def __repr__(self):
+        return f"MeshTopology({self.axis_sizes})"
+
+
+# ------------------------------------------------------------------
+# Module-level singleton + getters, mirroring reference utils/groups.py
+# ------------------------------------------------------------------
+_TOPOLOGY: Optional[MeshTopology] = None
+
+
+def initialize_mesh(config: Optional[MeshConfig] = None, devices=None, force: bool = False) -> MeshTopology:
+    """Build (or return) the global mesh. Reference: ``groups.initialize`` (``groups.py:52``)."""
+    global _TOPOLOGY
+    if _TOPOLOGY is None or force:
+        _TOPOLOGY = MeshTopology(config, devices)
+    return _TOPOLOGY
+
+
+def get_mesh_topology(required: bool = True) -> Optional[MeshTopology]:
+    if _TOPOLOGY is None and required:
+        raise RuntimeError("Mesh not initialized — call deepspeed_tpu.initialize() or initialize_mesh() first")
+    return _TOPOLOGY
+
+
+def reset_mesh():
+    global _TOPOLOGY
+    _TOPOLOGY = None

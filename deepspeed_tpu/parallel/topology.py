@@ -1,0 +1,148 @@
+"""Named-axis cartesian process/device topology.
+
+Capability analogue of the reference ``runtime/pipe/topology.py``
+(``ProcessTopology``, ``PipeDataParallelTopology``,
+``PipeModelDataParallelTopology``): a rank <-> coordinate bijection over a
+grid of named axes, plus group enumeration along axes. On TPU the same
+math also defines the ``jax.sharding.Mesh`` layout (see ``mesh.py``), so
+this module is pure arithmetic with no communication.
+"""
+
+from collections import namedtuple
+from itertools import product
+from typing import Dict, List, Sequence, Tuple
+
+
+class ProcessTopology:
+    """A cartesian grid of ranks with named axes (row-major, first axis slowest)."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims must have equal length")
+        self.axes = list(axes)
+        self.dims = list(int(d) for d in dims)
+        for d in self.dims:
+            if d < 1:
+                raise ValueError(f"axis dims must be >= 1, got {self.dims}")
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self._coord_to_rank: Dict[tuple, int] = {}
+        for rank, coord in enumerate(product(*[range(d) for d in self.dims])):
+            self._coord_to_rank[self.ProcessCoord(*coord)] = rank
+        self._rank_to_coord = {r: c for c, r in self._coord_to_rank.items()}
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if sorted(coord_kwargs.keys()) != sorted(self.axes):
+            raise ValueError(f"get_rank() requires all axes {self.axes}, got {list(coord_kwargs)}")
+        return self._coord_to_rank[self.ProcessCoord(**coord_kwargs)]
+
+    def get_coord(self, rank: int):
+        return self._rank_to_coord[rank]
+
+    def get_axis_names(self) -> List[str]:
+        return list(self.axes)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def world_size(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """All rank-groups that vary only along ``axis`` (one group per
+        combination of the other axes). These are the process groups the
+        reference builds with ``dist.new_group``; here they name mesh-axis
+        sub-views."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        for combo in product(*[range(self.get_dim(a)) for a in other_axes]):
+            fixed = dict(zip(other_axes, combo))
+            ranks = [self.get_rank(**{axis: i, **fixed}) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """Ranks whose coordinates match all given axis=value constraints."""
+
+        def matches(rank):
+            coord = self.get_coord(rank)
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+
+        return [r for r in range(self.world_size()) if matches(r)]
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        return self.filter_match(**{axis: idx})
+
+    def __str__(self):
+        return f"ProcessTopology(axes={self.axes}, dims={self.dims})"
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """pipe × data grid; data is innermost so DP groups are ICI-adjacent."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """pipe × data × model 3D grid (model/tensor innermost for fastest collectives)."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Axis-rank bookkeeping for the pipeline engine.
+
+    Capability analogue of reference ``topology.py:251`` — exposes
+    stage/data/model ranks and peer lookups. Communication groups are not
+    materialized (collectives ride mesh axes); this is coordinate math only.
+    """
+
+    def __init__(self, topology: ProcessTopology, global_rank: int = 0):
+        self._topo = topology
+        self.global_rank = global_rank
+        self.data_parallel_size = max(1, topology.get_dim("data"))
+        self.pipe_parallel_size = max(1, topology.get_dim("pipe"))
+        self.model_parallel_size = max(1, topology.get_dim("model"))
+        self.world_size = topology.world_size()
+        coord = topology.get_coord(global_rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0)
+
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_id(self) -> int:
+        return self.data_parallel_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_global_rank_from_stage(self, stage_id: int, **other) -> int:
+        kwargs = {"pipe": stage_id, "data": other.get("data", self.data_parallel_id)}
+        if "model" in self._topo.get_axis_names():
+            kwargs["model"] = other.get("model", self.model_parallel_id)
+        return self._topo.get_rank(**kwargs)
+
+    def stage_to_global(self, stage_id: int) -> int:
+        return self.get_global_rank_from_stage(stage_id)
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1

@@ -1,0 +1,127 @@
+"""Config-model machinery.
+
+TPU-native analogue of the reference ``runtime/config_utils.py``: the
+reference uses pydantic models with field aliasing + deprecation handling;
+here a light dataclass base gives the same contract (dict in, validated
+typed tree out, unknown-key warnings, alias and deprecated-key support)
+without a pydantic dependency.
+"""
+
+import dataclasses
+import json
+from dataclasses import dataclass, field, fields
+from typing import Any, Dict, List, Optional, Type, TypeVar, get_args, get_origin
+
+from ..utils.logging import logger
+
+T = TypeVar("T", bound="DeepSpeedConfigModel")
+
+
+def ds_field(default=dataclasses.MISSING, *, default_factory=dataclasses.MISSING, aliases: Optional[List[str]] = None,
+             deprecated: bool = False, new_param: Optional[str] = None, ge=None, le=None, gt=None, lt=None):
+    """Declare a config field with aliases / deprecation / bounds metadata."""
+    metadata = {
+        "aliases": aliases or [],
+        "deprecated": deprecated,
+        "new_param": new_param,
+        "bounds": (ge, le, gt, lt),
+    }
+    if default_factory is not dataclasses.MISSING:
+        return field(default_factory=default_factory, metadata=metadata)
+    return field(default=default, metadata=metadata)
+
+
+def _is_config_model(tp) -> bool:
+    return isinstance(tp, type) and issubclass(tp, DeepSpeedConfigModel)
+
+
+def _unwrap_optional(tp):
+    if get_origin(tp) is not None and type(None) in get_args(tp):
+        args = [a for a in get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+@dataclass
+class DeepSpeedConfigModel:
+    """Base for every config sub-tree. Build with ``from_dict``."""
+
+    @classmethod
+    def from_dict(cls: Type[T], data: Optional[Dict[str, Any]] = None, strict: bool = False) -> T:
+        data = dict(data or {})
+        kwargs = {}
+        known_keys = set()
+        for f in fields(cls):
+            names = [f.name] + list(f.metadata.get("aliases", []))
+            known_keys.update(names)
+            value = dataclasses.MISSING
+            for name in names:
+                if name in data:
+                    value = data.pop(name)
+                    if f.metadata.get("deprecated"):
+                        new_param = f.metadata.get("new_param")
+                        logger.warning(
+                            f"Config parameter {name} is deprecated" + (f", use {new_param} instead" if new_param else ""))
+                    break
+            if value is dataclasses.MISSING:
+                continue
+            ftype = _unwrap_optional(f.type if not isinstance(f.type, str) else cls.__annotations__.get(f.name, f.type))
+            if isinstance(ftype, str):  # string annotation we can't resolve; pass through
+                kwargs[f.name] = value
+                continue
+            if _is_config_model(ftype) and isinstance(value, dict):
+                value = ftype.from_dict(value, strict=strict)
+            elif _is_config_model(ftype) and isinstance(value, bool):
+                # `"feature": true` shorthand for `{"enabled": true}`
+                value = ftype.from_dict({"enabled": value}, strict=strict)
+            kwargs[f.name] = value
+        if data:
+            msg = f"Unknown config keys for {cls.__name__}: {sorted(data.keys())}"
+            if strict:
+                raise ValueError(msg)
+            logger.warning(msg)
+        inst = cls(**kwargs)
+        inst._validate_bounds()
+        if hasattr(inst, "validate"):
+            inst.validate()
+        return inst
+
+    def _validate_bounds(self):
+        for f in fields(self):
+            ge, le, gt, lt = f.metadata.get("bounds", (None, None, None, None)) if f.metadata else (None,) * 4
+            v = getattr(self, f.name)
+            if v is None or not isinstance(v, (int, float)) or isinstance(v, bool):
+                continue
+            if ge is not None and v < ge:
+                raise ValueError(f"{type(self).__name__}.{f.name}={v} must be >= {ge}")
+            if le is not None and v > le:
+                raise ValueError(f"{type(self).__name__}.{f.name}={v} must be <= {le}")
+            if gt is not None and v <= gt:
+                raise ValueError(f"{type(self).__name__}.{f.name}={v} must be > {gt}")
+            if lt is not None and v >= lt:
+                raise ValueError(f"{type(self).__name__}.{f.name}={v} must be < {lt}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        for f in fields(self):
+            v = getattr(self, f.name)
+            if isinstance(v, DeepSpeedConfigModel):
+                v = v.to_dict()
+            out[f.name] = v
+        return out
+
+    def __str__(self):
+        return f"{type(self).__name__}({json.dumps(self.to_dict(), default=str)})"
+
+
+def get_scalar_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict: Dict, param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)

@@ -1,0 +1,7 @@
+from .logging import log_dist, logger, print_rank_0, warning_once
+from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
+from . import groups
+from .memory import see_memory_usage
+
+__all__ = ["logger", "log_dist", "print_rank_0", "warning_once", "SynchronizedWallClockTimer", "ThroughputTimer",
+           "NoopTimer", "groups", "see_memory_usage"]

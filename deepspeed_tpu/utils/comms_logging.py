@@ -1,0 +1,112 @@
+"""Per-op communication telemetry.
+
+Capability analogue of reference ``utils/comms_logging.py``: every façade
+collective can be timed and fed into a ``CommsLogger`` that tracks message
+sizes, latencies and achieved algorithmic/bus bandwidth, with a
+``log_summary()`` rollup (reference ``comm/comm.py:422``).
+"""
+
+import math
+from typing import Dict
+
+from .logging import logger
+
+
+def get_caller_func(frame: int = 3) -> str:
+    import sys
+
+    try:
+        return sys._getframe(frame).f_code.co_name
+    except Exception:
+        return "unknown"
+
+
+def convert_size(size_bytes: int) -> str:
+    if size_bytes == 0:
+        return "0B"
+    names = ("B", "KB", "MB", "GB", "TB", "PB")
+    i = int(math.floor(math.log(size_bytes, 1024)))
+    p = math.pow(1024, i)
+    return f"{round(size_bytes / p, 2)} {names[i]}"
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n: int) -> tuple:
+    """Algorithmic and bus bandwidth in Gbps for a collective of ``size_bytes``
+    over ``n`` participants taking ``duration_s`` seconds.
+
+    Bus-bandwidth correction factors follow the standard nccl-tests
+    conventions the reference uses (``comms_logging.py:34``).
+    """
+    duration_s = max(duration_s, 1e-9)
+    n = max(n, 1)
+    if comm_op in ("all_to_all_single", "all_to_all"):
+        tput = size_bytes / duration_s
+        busbw = (size_bytes / duration_s) * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter", "reduce_scatter_tensor",
+                     "all_gather_object"):
+        size_bytes = size_bytes * n
+        tput = size_bytes / duration_s
+        busbw = (size_bytes / duration_s) * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        tput = size_bytes * 2 / duration_s
+        busbw = (size_bytes / duration_s) * (2 * (n - 1) / n)
+    else:  # send/recv/broadcast/reduce/barrier
+        tput = size_bytes / duration_s
+        busbw = tput
+    tput_gbps = tput * 8 / 1e9
+    busbw_gbps = busbw * 8 / 1e9
+    return tput_gbps, busbw_gbps
+
+
+class CommsLogger:
+    """Reference: ``utils/comms_logging.py:67``."""
+
+    def __init__(self, enabled=False, verbose=False, prof_all=True, debug=False, prof_ops=None):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.debug = debug
+        self.prof_ops = prof_ops or []
+        self.comms_dict: Dict[str, Dict[int, list]] = {}
+
+    def configure(self, comms_config):
+        self.enabled = comms_config.enabled
+        self.verbose = comms_config.verbose
+        self.prof_all = comms_config.prof_all
+        self.debug = comms_config.debug
+        self.prof_ops = list(comms_config.prof_ops)
+
+    def should_profile(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, raw_name: str, record_name: str, latency_s: float, msg_size: int, world_size: int):
+        algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s, world_size)
+        per_op = self.comms_dict.setdefault(record_name, {})
+        rec = per_op.setdefault(msg_size, [0, [], [], []])
+        rec[0] += 1
+        rec[1].append(latency_s)
+        rec[2].append(algbw)
+        rec[3].append(busbw)
+        if self.verbose:
+            logger.info(
+                f"comm op: {record_name} | time (ms): {latency_s * 1e3:.2f} | msg size: {convert_size(msg_size)} | "
+                f"algbw (Gbps): {algbw:.2f} | busbw (Gbps): {busbw:.2f}")
+
+    def log_all(self, print_log: bool = True, show_straggler: bool = False):
+        import numpy as np
+
+        output = [f"{'Comm. Op':<20}{'Message Size':<20}{'Count':<10}{'Total Latency(ms)':<20}"
+                  f"{'Avg Latency(ms)':<20}{'tput_avg (Gbps)':<20}{'busbw_avg (Gbps)':<20}"]
+        for record_name, sizes in sorted(self.comms_dict.items()):
+            output.append(record_name)
+            for size, (count, lats, algs, buses) in sorted(sizes.items()):
+                total_ms = sum(lats) * 1e3
+                avg_ms = total_ms / max(count, 1)
+                output.append(f"{'':<20}{convert_size(size):<20}{count:<10}{total_ms:<20.2f}"
+                              f"{avg_ms:<20.2f}{float(np.mean(algs)):<20.2f}{float(np.mean(buses)):<20.2f}")
+        text = "\n".join(output)
+        if print_log:
+            logger.info("\n" + text)
+        return text

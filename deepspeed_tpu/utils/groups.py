@@ -1,0 +1,149 @@
+"""Parallel-group getters.
+
+API parity with the reference ``deepspeed/utils/groups.py`` (getters at
+``groups.py:397-515``): callers ask for the world size / rank along each
+parallel dimension. On TPU a "group" is a mesh axis; "rank in group" is the
+host-process coordinate along that axis (meaningful on multi-host, always 0
+for the in-jit SPMD view where XLA owns per-device identity).
+"""
+
+from typing import Optional
+
+from ..parallel.mesh import get_mesh_topology, initialize_mesh, reset_mesh  # noqa: F401 (re-export)
+
+
+def _topo():
+    return get_mesh_topology(required=True)
+
+
+def initialize(ep_size: int = 1, mpu=None):
+    """Reference-compat entry (``groups.py:52``): expert-parallel size is a
+    mesh axis here, so this validates rather than constructs groups."""
+    topo = get_mesh_topology(required=False)
+    if topo is not None and ep_size not in (1, topo.expert_parallel_size):
+        raise ValueError(
+            f"ep_size {ep_size} conflicts with mesh expert axis {topo.expert_parallel_size}; set mesh.expert in config")
+    return topo
+
+
+# -- world sizes --
+def get_data_parallel_world_size() -> int:
+    return _topo().data_parallel_size
+
+
+def get_model_parallel_world_size() -> int:
+    return _topo().model_parallel_size
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _topo().model_parallel_size
+
+
+def get_expert_parallel_world_size(group_name: str = "") -> int:
+    return _topo().expert_parallel_size
+
+
+def get_expert_data_parallel_world_size(group_name: str = "") -> int:
+    return max(1, get_data_parallel_world_size() // get_expert_parallel_world_size())
+
+
+def get_sequence_parallel_world_size() -> int:
+    return _topo().sequence_parallel_size
+
+
+def get_pipe_parallel_world_size() -> int:
+    return _topo().pipe_parallel_size
+
+
+def get_context_parallel_world_size() -> int:
+    return _topo().context_parallel_size
+
+
+def get_zero_param_shard_size() -> int:
+    return _topo().sharding_size
+
+
+# -- axis names for in-jit collectives --
+def get_data_parallel_axis():
+    return _topo().batch_axes
+
+
+def get_model_parallel_axis() -> str:
+    return "tensor"
+
+
+def get_expert_parallel_axis() -> str:
+    return "expert"
+
+
+def get_sequence_parallel_axis() -> str:
+    return "seq"
+
+
+def get_context_parallel_axis() -> str:
+    return "context"
+
+
+def get_fsdp_axis() -> str:
+    return "fsdp"
+
+
+# -- ranks (host-process view; 0 on single-host) --
+def _process_coord(axis: str) -> int:
+    import jax
+
+    topo = _topo()
+    # Host index -> first device it owns -> coordinate along axis.
+    try:
+        local0 = jax.local_devices()[0]
+        flat = list(topo.mesh.devices.flat)
+        rank = flat.index(local0)
+        coord = topo.topology.get_coord(rank)
+        return getattr(coord, axis, 0)
+    except Exception:
+        return 0
+
+
+def get_data_parallel_rank() -> int:
+    return _process_coord("data")
+
+
+def get_model_parallel_rank() -> int:
+    return _process_coord("tensor")
+
+
+def get_tensor_model_parallel_rank() -> int:
+    return _process_coord("tensor")
+
+
+def get_expert_parallel_rank(group_name: str = "") -> int:
+    return _process_coord("expert")
+
+
+def get_sequence_parallel_rank() -> int:
+    return _process_coord("seq")
+
+
+def get_pipe_parallel_rank() -> int:
+    return _process_coord("pipe")
+
+
+# group objects do not exist on TPU; return axis names for compatibility
+def get_data_parallel_group():
+    return get_data_parallel_axis()
+
+
+def get_model_parallel_group():
+    return get_model_parallel_axis()
+
+
+def get_expert_parallel_group(group_name: str = ""):
+    return get_expert_parallel_axis()
+
+
+def get_sequence_parallel_group():
+    return get_sequence_parallel_axis()
+
+
+def get_context_parallel_group():
+    return get_context_parallel_axis()

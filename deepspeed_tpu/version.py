@@ -1,0 +1,8 @@
+"""Version info for deepspeed_tpu."""
+
+__version__ = "0.1.0"
+__git_hash__ = None
+__git_branch__ = None
+
+# Capability parity target: DeepSpeed 0.14.3 (see SURVEY.md).
+reference_version = "0.14.3"

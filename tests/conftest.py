@@ -1,0 +1,40 @@
+"""Test harness: simulate an 8-device TPU slice on CPU.
+
+Mirrors the reference's distributed-without-a-cluster strategy
+(``tests/unit/common.py``: fork N processes over loopback NCCL/gloo). The
+TPU-native analogue is a faked 8-device host platform — real XLA
+collectives, single process (SURVEY.md §4 "TPU translation").
+MUST run before the first ``import jax`` anywhere in the test session.
+"""
+
+import os
+
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+os.environ["JAX_PLATFORMS"] = "cpu"  # the host env may point at a real TPU tunnel
+os.environ.setdefault("DS_ACCELERATOR", "tpu")
+
+# The container's sitecustomize imports jax at interpreter start (before this
+# file), locking in the env's JAX_PLATFORMS — override via config, which still
+# works because backends initialize lazily.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    yield
+    from deepspeed_tpu.parallel.mesh import reset_mesh
+
+    reset_mesh()
+
+
+@pytest.fixture
+def mesh8():
+    """A pipe=1, data=8 default mesh over the 8 faked devices."""
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+
+    return initialize_mesh(force=True)

@@ -1,0 +1,85 @@
+"""Config-system tests. Reference coverage model: ``tests/unit/runtime/test_ds_config_dict.py``."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import (BF16Config, DeepSpeedConfig, FP16Config, MeshConfig, ZeroConfig)
+
+
+def test_batch_triangulation_micro_and_gas():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3}, world_size=8)
+    assert cfg.train_batch_size == 2 * 3 * 8
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 3
+
+
+def test_batch_triangulation_train_and_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}, world_size=4)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triangulation_only_train():
+    cfg = DeepSpeedConfig({"train_batch_size": 16}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_inconsistent_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(
+            {"train_batch_size": 10, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 3},
+            world_size=8)
+
+
+def test_mesh_reduces_dp_world_size():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1, "mesh": {"tensor": 2, "data": -1}}, world_size=8)
+    # 8 devices / tensor 2 => dp 4
+    assert cfg.train_batch_size == 4
+
+
+def test_fp16_bf16_conflict():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_zero_defaults_and_aliases():
+    z = ZeroConfig.from_dict({"stage": 2, "cpu_offload": True})
+    assert z.stage == 2
+    assert z.offload_optimizer.device == "cpu"
+    assert z.overlap_comm is False  # stage != 3 default
+    z3 = ZeroConfig.from_dict({"stage": 3})
+    assert z3.overlap_comm is True
+
+
+def test_zero_stage_bounds():
+    with pytest.raises(ValueError):
+        ZeroConfig.from_dict({"stage": 5})
+
+
+def test_fp16_dynamic_loss_scale():
+    f = FP16Config.from_dict({"enabled": True})
+    assert f.dynamic_loss_scale
+    f2 = FP16Config.from_dict({"enabled": True, "loss_scale": 128})
+    assert not f2.dynamic_loss_scale
+
+
+def test_bool_shorthand_for_subconfig():
+    cfg = DeepSpeedConfig({"bf16": {"enabled": True}})
+    assert cfg.bf16.enabled
+    assert not cfg.fp16.enabled
+
+
+def test_precision_dtype():
+    import jax.numpy as jnp
+
+    assert DeepSpeedConfig({"bf16": {"enabled": True}}).precision_dtype == jnp.bfloat16
+    assert DeepSpeedConfig({}).precision_dtype == jnp.float32
+
+
+def test_unknown_keys_warn_not_raise():
+    cfg = DeepSpeedConfig({"zero_optimization": {"stage": 1, "not_a_real_knob": 7}})
+    assert cfg.zero_config.stage == 1
+
+
+def test_mesh_config_defaults():
+    m = MeshConfig.from_dict({})
+    assert m.data == -1 and m.tensor == 1

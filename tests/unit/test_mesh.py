@@ -1,0 +1,56 @@
+"""Mesh construction tests."""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.parallel.mesh import MeshTopology, initialize_mesh
+from deepspeed_tpu.runtime.config import MeshConfig
+from deepspeed_tpu.utils import groups
+
+
+def test_default_mesh_all_data():
+    topo = MeshTopology()
+    assert topo.n_devices == 8
+    assert topo.axis_size("data") == 8
+    assert topo.data_parallel_size == 8
+
+
+def test_mixed_axes():
+    topo = MeshTopology(MeshConfig.from_dict({"data": -1, "tensor": 2, "pipe": 2}))
+    assert topo.axis_size("data") == 2
+    assert topo.model_parallel_size == 2
+    assert topo.pipe_parallel_size == 2
+    assert topo.data_parallel_size == 2
+
+
+def test_fsdp_counts_as_dp_for_batch():
+    topo = MeshTopology(MeshConfig.from_dict({"data": 1, "fsdp": 8}))
+    assert topo.data_parallel_size == 8
+    assert topo.sharding_size == 8
+    assert topo.batch_axes == ("fsdp",)
+
+
+def test_bad_axis_product():
+    with pytest.raises(ValueError):
+        MeshTopology(MeshConfig.from_dict({"data": 3, "tensor": 2}))
+
+
+def test_two_wildcards_rejected():
+    with pytest.raises(ValueError):
+        MeshTopology(MeshConfig.from_dict({"data": -1, "fsdp": -1}))
+
+
+def test_sharding_placement():
+    topo = MeshTopology(MeshConfig.from_dict({"data": 4, "tensor": 2}))
+    x = jax.device_put(np.zeros((8, 16)), topo.sharding("data", "tensor"))
+    assert len(x.addressable_shards) == 8
+    assert x.addressable_shards[0].data.shape == (2, 8)  # 8/data4 x 16/tensor2
+
+
+def test_groups_getters(mesh8):
+    assert groups.get_data_parallel_world_size() == 8
+    assert groups.get_model_parallel_world_size() == 1
+    assert groups.get_expert_parallel_world_size() == 1
+    assert groups.get_sequence_parallel_world_size() == 1
+    assert groups.get_data_parallel_rank() == 0

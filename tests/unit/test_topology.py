@@ -1,0 +1,61 @@
+"""Topology math tests. Reference coverage model: ``tests/unit/runtime/pipe/test_topology.py``."""
+
+import pytest
+
+from deepspeed_tpu.parallel.topology import (PipeDataParallelTopology, PipeModelDataParallelTopology,
+                                             PipelineParallelGrid, ProcessTopology)
+
+
+def test_rank_coord_bijection():
+    topo = ProcessTopology(["pipe", "data"], [2, 4])
+    assert topo.world_size() == 8
+    seen = set()
+    for r in range(8):
+        c = topo.get_coord(r)
+        assert topo.get_rank(pipe=c.pipe, data=c.data) == r
+        seen.add((c.pipe, c.data))
+    assert len(seen) == 8
+
+
+def test_row_major_ordering():
+    topo = ProcessTopology(["pipe", "data"], [2, 2])
+    assert topo.get_rank(pipe=0, data=0) == 0
+    assert topo.get_rank(pipe=0, data=1) == 1
+    assert topo.get_rank(pipe=1, data=0) == 2
+
+
+def test_axis_comm_lists():
+    topo = PipeDataParallelTopology(num_pp=2, num_dp=4)
+    dp_lists = topo.get_axis_comm_lists("data")
+    assert len(dp_lists) == 2
+    for lst in dp_lists:
+        assert len(lst) == 4
+        coords = [topo.get_coord(r) for r in lst]
+        assert len({c.pipe for c in coords}) == 1
+
+    pp_lists = topo.get_axis_comm_lists("pipe")
+    assert len(pp_lists) == 4
+    assert all(len(lst) == 2 for lst in pp_lists)
+
+
+def test_filter_match():
+    topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+    ranks = topo.filter_match(pipe=1)
+    assert len(ranks) == 4
+    assert all(topo.get_coord(r).pipe == 1 for r in ranks)
+
+
+def test_grid_stage_bookkeeping():
+    topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+    grid = PipelineParallelGrid(topo, global_rank=topo.get_rank(pipe=2, data=1))
+    assert grid.get_stage_id() == 2
+    assert grid.get_data_parallel_id() == 1
+    assert not grid.is_first_stage() and not grid.is_last_stage()
+    assert grid.stage_to_global(3) == topo.get_rank(pipe=3, data=1)
+
+
+def test_invalid_dims():
+    with pytest.raises(ValueError):
+        ProcessTopology(["a"], [0])
+    with pytest.raises(ValueError):
+        ProcessTopology(["a", "b"], [2])
