@@ -1,0 +1,311 @@
+"""Decoder-only transformer family (GPT-2 style and Llama style).
+
+These play the role of the reference's test/bench models
+(``tests/unit/simple_model.py``, Megatron/HF models in examples): the
+framework is model-agnostic, but ships first-class implementations that
+are TPU-shaped — einsum matmuls onto the MXU, bf16 activations, static
+shapes, optional remat and scan-over-layers, attention dispatched through
+the kernel registry (Pallas flash on TPU).
+
+Tensor-parallel sharding is declared as partition rules (param-path ->
+PartitionSpec) rather than module surgery: the AutoTP analogue
+(reference ``module_inject/auto_tp.py``) consumes these rules.
+"""
+
+import dataclasses
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    n_layers: int = 2
+    n_heads: int = 4
+    n_kv_heads: Optional[int] = None  # < n_heads => GQA (llama-70b style)
+    d_model: int = 128
+    d_ff: Optional[int] = None  # default: 4*d_model (gelu) or 8/3*d_model (swiglu)
+    max_seq_len: int = 2048
+    norm: str = "layernorm"  # layernorm | rmsnorm
+    activation: str = "gelu"  # gelu | swiglu
+    pos_emb: str = "learned"  # learned | rope
+    rope_theta: float = 10000.0
+    tie_embeddings: bool = True
+    dtype: Any = jnp.float32  # activation/compute dtype
+    norm_eps: float = 1e-5
+    dropout: float = 0.0
+    remat: bool = False  # jax.checkpoint each block (activation checkpointing)
+    scan_layers: bool = False  # lax.scan over layers (fast compile, pipeline-friendly)
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def ffn_dim(self) -> int:
+        if self.d_ff is not None:
+            return self.d_ff
+        if self.activation == "swiglu":
+            return int(8 * self.d_model / 3 + 127) // 128 * 128 if self.d_model >= 128 else 2 * self.d_model
+        return 4 * self.d_model
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# -------------------- layers --------------------
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+class LayerNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros, (x.shape[-1],), jnp.float32)
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + self.eps)
+        return (y * scale + bias).astype(self.dtype)
+
+
+def make_norm(cfg: TransformerConfig):
+    return (RMSNorm if cfg.norm == "rmsnorm" else LayerNorm)(eps=cfg.norm_eps, dtype=cfg.dtype)
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    inv = 1.0 / (theta**(jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # (L, D/2)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
+    """x: (B,S,H,D); positions: (B,S) absolute token positions."""
+    c = cos[positions][:, :, None, :]  # (B,S,1,D/2)
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, segment_ids=None):
+        cfg = self.cfg
+        B, S, _ = x.shape
+        H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feats, name: nn.DenseGeneral(feats, axis=-1, use_bias=cfg.norm == "layernorm", name=name,
+                                                    dtype=cfg.dtype, param_dtype=jnp.float32)
+        q = dense((H, D), "q_proj")(x)
+        k = dense((KVH, D), "k_proj")(x)
+        v = dense((KVH, D), "v_proj")(x)
+
+        if cfg.pos_emb == "rope":
+            cos, sin = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
+            q = apply_rope(q, cos, sin, positions)
+            k = apply_rope(k, cos, sin, positions)
+
+        new_cache = None
+        if kv_cache is not None:
+            # decode: append to cache at position offset
+            ck, cv, cache_len = kv_cache
+            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_len, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_len, 0, 0))
+            k, v = ck, cv
+            new_cache = (ck, cv, cache_len + S)
+
+        out = attention(q, k, v, causal=True, segment_ids=segment_ids)
+        out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.norm == "layernorm", name="o_proj",
+                              dtype=cfg.dtype, param_dtype=jnp.float32)(out)
+        return (out, new_cache) if kv_cache is not None else out
+
+
+class MLP(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        bias = cfg.norm == "layernorm"
+        if cfg.activation == "swiglu":
+            gate = nn.Dense(cfg.ffn_dim, use_bias=bias, name="gate_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+            up = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+            h = nn.gelu(h)
+        return nn.Dense(cfg.d_model, use_bias=bias, name="down_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(h)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, kv_cache=None, segment_ids=None):
+        cfg = self.cfg
+        attn = Attention(cfg, name="attn")
+        if kv_cache is not None:
+            a, new_cache = attn(make_norm(cfg)(x), positions, kv_cache, segment_ids)
+        else:
+            a, new_cache = attn(make_norm(cfg)(x), positions, None, segment_ids), None
+        x = x + a
+        x = x + MLP(cfg, name="mlp")(make_norm(cfg)(x))
+        return (x, new_cache) if kv_cache is not None else x
+
+
+class Transformer(nn.Module):
+    """Causal LM. ``__call__`` returns logits; ``loss`` the mean token CE."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None):
+        cfg = self.cfg
+        B, S = input_ids.shape
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        emb = self.param("wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.d_model), jnp.float32)
+        x = emb[input_ids].astype(cfg.dtype)
+        if cfg.pos_emb == "learned":
+            wpe = self.param("wpe", nn.initializers.normal(0.02), (cfg.max_seq_len, cfg.d_model), jnp.float32)
+            x = x + wpe[positions].astype(cfg.dtype)
+
+        new_caches = [] if kv_caches is not None else None
+        block_cls = Block
+        if cfg.remat and kv_caches is None:
+            block_cls = nn.remat(Block, static_argnums=())
+        if cfg.scan_layers and kv_caches is None:
+            x = self._scan_blocks(block_cls, x, positions, segment_ids)
+        else:
+            for i in range(cfg.n_layers):
+                blk = block_cls(cfg, name=f"layer_{i}")
+                if kv_caches is not None:
+                    x, c = blk(x, positions, kv_caches[i], segment_ids)
+                    new_caches.append(c)
+                else:
+                    x = blk(x, positions, None, segment_ids)
+
+        x = make_norm(cfg)(x)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(cfg.dtype))
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=cfg.dtype,
+                              param_dtype=jnp.float32)(x)
+        logits = logits.astype(jnp.float32)
+        return (logits, new_caches) if kv_caches is not None else logits
+
+    def _scan_blocks(self, block_cls, x, positions, segment_ids):
+        cfg = self.cfg
+
+        class ScanBody(nn.Module):
+            cfg: TransformerConfig
+
+            @nn.compact
+            def __call__(self, carry, _):
+                y = block_cls(self.cfg, name="block")(carry, positions, None, segment_ids)
+                return y, None
+
+        scanned = nn.scan(ScanBody, variable_axes={"params": 0}, split_rngs={"params": True}, length=cfg.n_layers,
+                          metadata_params={nn.PARTITION_NAME: "layers"})
+        x, _ = scanned(cfg, name="layers")(x, None)
+        return x
+
+
+def cross_entropy_loss(logits: jnp.ndarray, labels: jnp.ndarray, ignore_index: int = -100) -> jnp.ndarray:
+    """Mean CE over non-ignored positions; logits fp32 (B,S,V), labels (B,S)."""
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    nll = (logz - gold) * valid
+    return jnp.sum(nll) / jnp.maximum(jnp.sum(valid), 1)
+
+
+class CausalLM:
+    """Binds a Transformer to the engine's ``loss_fn(params, batch, rng)`` contract.
+
+    Batch convention: dict with ``input_ids`` (B,S) int32 and optional
+    ``labels`` (shifted internally if absent).
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+        self.module = Transformer(cfg)
+
+    def init(self, rng, example_batch) -> Dict:
+        return self.module.init(rng, example_batch["input_ids"])["params"]
+
+    def apply(self, params, input_ids, **kwargs):
+        return self.module.apply({"params": params}, input_ids, **kwargs)
+
+    def loss_fn(self, params, batch, rng=None) -> jnp.ndarray:
+        input_ids = batch["input_ids"]
+        logits = self.apply(params, input_ids)
+        if "labels" in batch:
+            labels = batch["labels"]
+            return cross_entropy_loss(logits, labels)
+        return cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
+
+    def partition_rules(self):
+        """(path-substring tuple, PartitionSpec) TP sharding rules — the
+        AutoTP-analogue metadata (column-parallel QKV/up, row-parallel o/down,
+        vocab-sharded embeddings). Paths are flax param path tuples."""
+        return [
+            (("wte",), P("tensor", None)),
+            (("wpe",), P(None, None)),
+            (("q_proj", "kernel"), P(None, "tensor", None)),
+            (("k_proj", "kernel"), P(None, "tensor", None)),
+            (("v_proj", "kernel"), P(None, "tensor", None)),
+            (("o_proj", "kernel"), P("tensor", None, None)),
+            (("gate_proj", "kernel"), P(None, "tensor")),
+            (("up_proj", "kernel"), P(None, "tensor")),
+            (("down_proj", "kernel"), P("tensor", None)),
+            (("lm_head", "kernel"), P(None, "tensor")),
+        ]
+
+
+# -------------------- presets --------------------
+def gpt2_tiny(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=1024, n_layers=2, n_heads=4, d_model=64, max_seq_len=256, **kw)
+
+
+def gpt2_125m(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=50257, n_layers=12, n_heads=12, d_model=768, max_seq_len=1024, **kw)
+
+
+def gpt2_1_3b(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=50257, n_layers=24, n_heads=32, d_model=2048, max_seq_len=1024, **kw)
+
+
+def llama_tiny(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=1024, n_layers=2, n_heads=4, n_kv_heads=2, d_model=64, max_seq_len=256,
+                             norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False, **kw)
+
+
+def llama2_7b(**kw) -> TransformerConfig:
+    return TransformerConfig(vocab_size=32000, n_layers=32, n_heads=32, d_model=4096, d_ff=11008, max_seq_len=4096,
+                             norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False, **kw)

@@ -1,0 +1,4 @@
+from .attention import attention
+from .registry import REGISTRY, get_op, register_op
+
+__all__ = ["attention", "REGISTRY", "get_op", "register_op"]

@@ -1,0 +1,67 @@
+"""Attention ops.
+
+The XLA path here is the always-available reference implementation; the
+Pallas flash kernel (``ops/pallas/flash_attention.py``) registers itself at
+higher priority when a real TPU backend is present. Capability parity:
+reference fused attention kernels (``csrc/transformer``,
+``csrc/transformer/inference``) and sparse attention (``ops/sparse_attention``).
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .registry import get_op, register_op
+
+
+def _repeat_kv(k: jnp.ndarray, n_rep: int) -> jnp.ndarray:
+    """Expand KV heads for grouped-query attention: (B,S,Hkv,D) -> (B,S,Hkv*n_rep,D)."""
+    if n_rep == 1:
+        return k
+    b, s, h, d = k.shape
+    return jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+
+
+@register_op("attention", "xla", priority=0)
+def attention_xla(q: jnp.ndarray,
+                  k: jnp.ndarray,
+                  v: jnp.ndarray,
+                  *,
+                  causal: bool = True,
+                  scale: Optional[float] = None,
+                  bias: Optional[jnp.ndarray] = None,
+                  segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Multi-head attention, shapes (B, S, H, D) / KV may have fewer heads (GQA).
+
+    Computed in fp32 accumulation regardless of input dtype (softmax
+    numerics), returned in the input dtype. XLA fuses the whole block.
+    """
+    orig_dtype = q.dtype
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
+    sq, sk = q.shape[1], k.shape[1]
+    if causal:
+        # offset supports decode where q is a suffix of the kv sequence
+        offset = sk - sq
+        qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + offset
+        ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        logits = jnp.where((ki <= qi)[None, None], logits, jnp.finfo(jnp.float32).min)
+    if segment_ids is not None:
+        seg_q, seg_k = segment_ids if isinstance(segment_ids, tuple) else (segment_ids, segment_ids)
+        mask = seg_q[:, :, None] == seg_k[:, None, :]
+        logits = jnp.where(mask[:, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out.astype(orig_dtype)
+
+
+def attention(q, k, v, **kwargs):
+    """Dispatch through the kernel registry (Pallas flash on TPU, XLA otherwise)."""
+    return get_op("attention")(q, k, v, **kwargs)

@@ -1,0 +1,109 @@
+"""Kernel registry — the TPU analogue of the reference's op_builder system.
+
+The reference JIT-compiles CUDA extensions per op (``op_builder/builder.py``);
+on TPU, kernels are Pallas (pure Python) or XLA-native, so "building"
+becomes registration + availability probing. ``ds_report``-style output
+comes from ``report()``.
+
+Each op name maps to an ordered list of implementations; the first whose
+``is_available()`` passes wins. ``set_impl`` force-selects (used by tests
+and by configs that disable Pallas).
+"""
+
+import os
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+
+
+@dataclass
+class OpImpl:
+    name: str  # e.g. "pallas", "xla"
+    fn: Callable
+    is_available: Callable[[], bool] = lambda: True
+    priority: int = 0  # higher wins
+
+
+class _Registry:
+    def __init__(self):
+        self._ops: Dict[str, List[OpImpl]] = {}
+        self._forced: Dict[str, str] = {}
+        self._cache: Dict[str, OpImpl] = {}
+
+    def register(self, op_name: str, impl_name: str, fn: Callable, is_available=None, priority: int = 0):
+        impls = self._ops.setdefault(op_name, [])
+        impls.append(OpImpl(impl_name, fn, is_available or (lambda: True), priority))
+        impls.sort(key=lambda i: -i.priority)
+        self._cache.pop(op_name, None)
+
+    def set_impl(self, op_name: str, impl_name: Optional[str]):
+        if impl_name is None:
+            self._forced.pop(op_name, None)
+        else:
+            self._forced[op_name] = impl_name
+        self._cache.pop(op_name, None)
+
+    def get(self, op_name: str) -> Callable:
+        if op_name in self._cache:
+            return self._cache[op_name].fn
+        impls = self._ops.get(op_name, [])
+        if not impls:
+            raise KeyError(f"No implementation registered for op '{op_name}'")
+        forced = self._forced.get(op_name) or os.environ.get(f"DS_TPU_OP_{op_name.upper()}")
+        if forced:
+            for impl in impls:
+                if impl.name == forced:
+                    self._cache[op_name] = impl
+                    return impl.fn
+            raise KeyError(f"Op '{op_name}' has no impl named '{forced}' (have {[i.name for i in impls]})")
+        for impl in impls:
+            try:
+                if impl.is_available():
+                    self._cache[op_name] = impl
+                    return impl.fn
+            except Exception as e:
+                logger.warning(f"op {op_name}/{impl.name} availability probe failed: {e}")
+        raise RuntimeError(f"No available implementation for op '{op_name}'")
+
+    def selected(self, op_name: str) -> str:
+        self.get(op_name)
+        return self._cache[op_name].name
+
+    def report(self) -> str:
+        """``ds_report`` analogue: one line per op with chosen + alternates."""
+        import jax
+
+        lines = ["-" * 60, "deepspeed_tpu op report", "-" * 60,
+                 f"jax backend: {jax.default_backend()} | devices: {jax.device_count()}", "-" * 60]
+        for op_name, impls in sorted(self._ops.items()):
+            try:
+                chosen = self.selected(op_name)
+            except Exception:
+                chosen = "UNAVAILABLE"
+            alts = ",".join(i.name for i in impls)
+            lines.append(f"{op_name:<30} selected={chosen:<10} [{alts}]")
+        return "\n".join(lines)
+
+
+REGISTRY = _Registry()
+
+
+def register_op(op_name: str, impl_name: str, is_available=None, priority: int = 0):
+    def deco(fn):
+        REGISTRY.register(op_name, impl_name, fn, is_available, priority)
+        return fn
+
+    return deco
+
+
+def get_op(op_name: str) -> Callable:
+    return REGISTRY.get(op_name)
+
+
+def pallas_available() -> bool:
+    """Pallas TPU kernels need a real TPU backend (Mosaic); the CPU-simulated
+    mesh used in tests falls back to interpret mode only when asked."""
+    import jax
+
+    return jax.default_backend() == "tpu"

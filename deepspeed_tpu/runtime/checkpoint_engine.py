@@ -1,0 +1,115 @@
+"""Checkpoint I/O engines.
+
+Parity: reference ``runtime/checkpoint_engine/`` (``CheckpointEngine`` ABC,
+torch + Nebula-async implementations). Here:
+
+- ``MsgpackCheckpointEngine`` — default: flax.serialization msgpack of full
+  (unsharded) pytrees. The layout is sharding-agnostic by construction —
+  the "universal checkpoint" property the reference needs an offline
+  converter for (``checkpoint/ds_to_universal.py``) is the native format.
+- ``OrbaxCheckpointEngine`` — async/tensorstore-backed sharded save for
+  large models (the Nebula-async analogue), used when available.
+"""
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ..utils.logging import logger
+
+
+class CheckpointEngine:
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag: str):
+        logger.info(f"[checkpoint] saving tag {tag}")
+
+    def save(self, state: Dict[str, Any], path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, template: Optional[Any] = None, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag: str) -> bool:
+        return True
+
+    def makedirs(self, path: str, exist_ok: bool = True):
+        os.makedirs(path, exist_ok=exist_ok)
+
+
+def _to_host(tree):
+    """Gather every leaf to host memory as numpy (sharding-agnostic)."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(jax.device_get(x))
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class MsgpackCheckpointEngine(CheckpointEngine):
+    def save(self, state: Dict[str, Any], path: str):
+        from flax import serialization
+
+        self.makedirs(os.path.dirname(path))
+        host_state = _to_host(state)
+        try:
+            blob = serialization.to_bytes(host_state)
+            with open(path, "wb") as f:
+                f.write(b"MSGP" + blob)
+        except Exception:
+            # fall back to pickle for exotic leaves (python scalars, configs)
+            with open(path, "wb") as f:
+                f.write(b"PICK" + pickle.dumps(host_state))
+
+    def load(self, path: str, template: Optional[Any] = None, map_location=None):
+        from flax import serialization
+
+        with open(path, "rb") as f:
+            magic = f.read(4)
+            blob = f.read()
+        if magic == b"PICK":
+            return pickle.loads(blob)
+        if template is not None:
+            return serialization.from_bytes(template, blob)
+        # state-dict restore without a template: nested dicts of arrays
+        return serialization.msgpack_restore(blob)
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Sharded/async save via orbax (tensorstore). Best for multi-host and
+    models too large to gather on one host."""
+
+    def __init__(self, config_params=None):
+        super().__init__(config_params)
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self._ckptr = ocp.PyTreeCheckpointer()
+
+    def save(self, state: Dict[str, Any], path: str):
+        self._ckptr.save(os.path.abspath(path), state, force=True)
+
+    def load(self, path: str, template: Optional[Any] = None, map_location=None):
+        if template is not None:
+            restore_args = jax.tree_util.tree_map(
+                lambda x: self._ocp.ArrayRestoreArgs(sharding=x.sharding)
+                if isinstance(x, jax.Array) else self._ocp.RestoreArgs(), template)
+            return self._ckptr.restore(os.path.abspath(path), item=template, restore_args=restore_args)
+        return self._ckptr.restore(os.path.abspath(path))
+
+
+def create_checkpoint_engine(config=None) -> CheckpointEngine:
+    name = os.environ.get("DS_TPU_CKPT_ENGINE", "msgpack")
+    if name == "orbax":
+        try:
+            return OrbaxCheckpointEngine(config)
+        except Exception as e:
+            logger.warning(f"orbax unavailable ({e}); using msgpack engine")
+    return MsgpackCheckpointEngine(config)

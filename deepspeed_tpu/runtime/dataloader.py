@@ -1,0 +1,96 @@
+"""Data loading onto the mesh.
+
+Parity: reference ``runtime/dataloader.py`` (``DeepSpeedDataLoader``). The
+TPU-native difference: there is ONE loader per host feeding *global*
+micro-batches (micro_batch_per_device × data-parallel degree), placed with
+``jax.device_put`` under the batch sharding so each device reads only its
+shard. Per-rank samplers become a deterministic global shuffle + slice.
+"""
+
+import math
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..parallel.mesh import MeshTopology
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of samples (dicts of arrays / tuples / arrays) into a batch."""
+    first = samples[0]
+    if isinstance(first, dict):
+        return {k: np.stack([np.asarray(s[k]) for s in samples]) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(np.stack([np.asarray(s[i]) for s in samples]) for i in range(len(first)))
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class DeepSpeedDataLoader:
+    def __init__(self,
+                 dataset,
+                 batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 shuffle: bool = False,
+                 seed: int = 0,
+                 drop_last: bool = True,
+                 topology: Optional[MeshTopology] = None,
+                 device_put: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.topology = topology
+        self.device_put = device_put
+        self.epoch = 0
+        n = len(dataset)
+        self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return self.len
+
+    def _order(self) -> np.ndarray:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            rng = np.random.RandomState(self.seed + self.epoch)
+            rng.shuffle(idx)
+        return idx
+
+    def _put(self, batch):
+        if not self.device_put or self.topology is None:
+            return batch
+        from .zero.partition import batch_specs, specs_to_shardings
+
+        shardings = specs_to_shardings(batch_specs(batch, self.topology), self.topology)
+        return jax.device_put(batch, shardings)
+
+    def __iter__(self) -> Iterator:
+        order = self._order()
+        for b in range(self.len):
+            sel = order[b * self.batch_size:(b + 1) * self.batch_size]
+            batch = self.collate_fn([self.dataset[int(i)] for i in sel])
+            yield self._put(batch)
+        self.epoch += 1
+
+
+class RepeatingLoader:
+    """Wraps an iterator to restart on StopIteration (reference ``pipe/engine``)."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)

@@ -1,0 +1,3 @@
+from .loss_scaler import DynamicLossScaler, LossScaler, create_loss_scaler
+
+__all__ = ["LossScaler", "DynamicLossScaler", "create_loss_scaler"]

@@ -1,0 +1,196 @@
+"""Learning-rate schedules.
+
+API parity with reference ``runtime/lr_schedules.py``: LRRangeTest,
+OneCycle, WarmupLR, WarmupDecayLR, WarmupCosineLR — python-side schedulers
+with ``step()/get_lr()/state_dict()/load_state_dict()``, driven by the
+engine at each optimizer boundary. The value feeds optax via
+``inject_hyperparams`` so there is no recompilation per LR change.
+"""
+
+import math
+from typing import Dict, List, Optional
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+WARMUP_COSINE_LR = "WarmupCosineLR"
+
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR, WARMUP_COSINE_LR]
+
+
+class _BaseSchedule:
+    def __init__(self):
+        self.last_batch_iteration = -1
+
+    def get_lr(self) -> List[float]:
+        raise NotImplementedError
+
+    def get_last_lr(self) -> List[float]:
+        return self._last_lr
+
+    def step(self, last_batch_iteration: Optional[int] = None):
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        self._last_lr = self.get_lr()
+        return self._last_lr
+
+    def state_dict(self) -> Dict:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: Dict):
+        self.last_batch_iteration = sd["last_batch_iteration"]
+        self._last_lr = self.get_lr()
+
+
+class WarmupLR(_BaseSchedule):
+    """Linear warmup from ``warmup_min_lr`` to ``warmup_max_lr`` then constant.
+
+    Reference: ``runtime/lr_schedules.py`` ``WarmupLR``.
+    """
+
+    def __init__(self, optimizer=None, warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000, warmup_type: str = "log", last_batch_iteration: int = -1):
+        super().__init__()
+        self.warmup_min_lr = warmup_min_lr
+        self.warmup_max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+        self.last_batch_iteration = last_batch_iteration
+
+    def _warmup_factor(self) -> float:
+        step = self.last_batch_iteration + 1
+        if step < self.warmup_num_steps:
+            if self.warmup_type == "log":
+                return self.inverse_log_warm_up * math.log(step + 1)
+            return step / self.warmup_num_steps
+        return 1.0
+
+    def get_lr(self) -> List[float]:
+        gamma = self._warmup_factor()
+        return [self.warmup_min_lr + (self.warmup_max_lr - self.warmup_min_lr) * gamma]
+
+
+class WarmupDecayLR(WarmupLR):
+    """Warmup then linear decay to 0 at ``total_num_steps``."""
+
+    def __init__(self, optimizer=None, total_num_steps: int = 10000, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000, warmup_type: str = "log",
+                 last_batch_iteration: int = -1):
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps, warmup_type, last_batch_iteration)
+        self.total_num_steps = total_num_steps
+
+    def _warmup_factor(self) -> float:
+        step = self.last_batch_iteration + 1
+        if step < self.warmup_num_steps:
+            return super()._warmup_factor()
+        return max(0.0, (self.total_num_steps - step) / max(1, self.total_num_steps - self.warmup_num_steps))
+
+
+class WarmupCosineLR(_BaseSchedule):
+    """Linear warmup (ratio) then cosine decay to ``cos_min_ratio``."""
+
+    def __init__(self, optimizer=None, total_num_steps: int = 10000, warmup_min_ratio: float = 0.0,
+                 warmup_num_steps: int = 1000, cos_min_ratio: float = 0.0001, warmup_type: str = "log",
+                 last_batch_iteration: int = -1):
+        super().__init__()
+        self.total_num_steps = total_num_steps
+        self.warmup_min_ratio = warmup_min_ratio
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        self.cos_min_ratio = cos_min_ratio
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+        self.last_batch_iteration = last_batch_iteration
+        self.org_lrs = [0.001]
+
+    def set_base_lr(self, lr: float):
+        self.org_lrs = [lr]
+
+    def get_lr_ratio(self) -> float:
+        step = self.last_batch_iteration + 1
+        if step < self.warmup_num_steps:
+            if self.warmup_type == "log":
+                gamma = self.inverse_log_warm_up * math.log(step + 1)
+            else:
+                gamma = step / self.warmup_num_steps
+            return self.warmup_min_ratio + (1.0 - self.warmup_min_ratio) * gamma
+        progress = min(1.0, (step - self.warmup_num_steps) / max(1, self.total_num_steps - self.warmup_num_steps))
+        cos = 0.5 * (1 + math.cos(math.pi * progress))
+        return self.cos_min_ratio + (1 - self.cos_min_ratio) * cos
+
+    def get_lr(self) -> List[float]:
+        return [lr * self.get_lr_ratio() for lr in self.org_lrs]
+
+
+class LRRangeTest(_BaseSchedule):
+    """LR range test: continuous/staircase ramp. Reference ``LRRangeTest``."""
+
+    def __init__(self, optimizer=None, lr_range_test_min_lr: float = 1e-3, lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0, lr_range_test_staircase: bool = False,
+                 last_batch_iteration: int = -1):
+        super().__init__()
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self) -> List[float]:
+        count = (self.last_batch_iteration + 1) / self.step_size
+        if self.staircase:
+            count = math.floor(count)
+        return [self.min_lr * (1 + count * self.step_rate)]
+
+
+class OneCycle(_BaseSchedule):
+    """1-cycle policy over LR. Reference ``OneCycle`` (momentum cycling is a
+    no-op here: optax momentum is fixed per optimizer construction)."""
+
+    def __init__(self, optimizer=None, cycle_min_lr: float = 1e-4, cycle_max_lr: float = 1e-3,
+                 decay_lr_rate: float = 0.0, cycle_first_step_size: int = 2000, cycle_second_step_size: Optional[int] = None,
+                 cycle_first_stair_count: int = 0, cycle_second_stair_count: Optional[int] = None,
+                 decay_step_size: int = 0, cycle_momentum: bool = False, cycle_min_mom: float = 0.8,
+                 cycle_max_mom: float = 0.9, decay_mom_rate: float = 0.0, last_batch_iteration: int = -1):
+        super().__init__()
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_size = cycle_first_step_size
+        self.second_size = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+        self.decay_step_size = decay_step_size
+        self.last_batch_iteration = last_batch_iteration
+
+    def get_lr(self) -> List[float]:
+        step = self.last_batch_iteration + 1
+        total_cycle = self.first_size + self.second_size
+        if step <= self.first_size:
+            frac = step / self.first_size
+            return [self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * frac]
+        if step <= total_cycle:
+            frac = (step - self.first_size) / self.second_size
+            return [self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * frac]
+        decay_steps = step - total_cycle
+        if self.decay_step_size > 0:
+            decay = self.decay_lr_rate * (decay_steps // self.decay_step_size)
+        else:
+            decay = self.decay_lr_rate * decay_steps
+        return [max(0.0, self.cycle_min_lr * (1 - decay)) if decay < 1 else 0.0]
+
+
+def get_lr_schedule_class(name: str):
+    mapping = {
+        LR_RANGE_TEST: LRRangeTest,
+        ONE_CYCLE: OneCycle,
+        WARMUP_LR: WarmupLR,
+        WARMUP_DECAY_LR: WarmupDecayLR,
+        WARMUP_COSINE_LR: WarmupCosineLR,
+    }
+    if name not in mapping:
+        raise ValueError(f"Unknown scheduler {name}; valid: {VALID_LR_SCHEDULES}")
+    return mapping[name]
+
+
+def create_lr_scheduler(name: str, params: Dict):
+    return get_lr_schedule_class(name)(optimizer=None, **params)

@@ -1,0 +1,8 @@
+from .module import LayerSpec, PipelineModule, TiedLayerSpec
+from .schedule import (BackwardPass, DataParallelSchedule, ForwardPass, InferenceSchedule, LoadMicroBatch,
+                       OptimizerStep, PipeSchedule, RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads,
+                       SendActivation, SendGrad, TrainSchedule)
+
+__all__ = ["PipelineModule", "LayerSpec", "TiedLayerSpec", "PipeSchedule", "TrainSchedule", "InferenceSchedule",
+           "DataParallelSchedule", "ForwardPass", "BackwardPass", "SendActivation", "RecvActivation", "SendGrad",
+           "RecvGrad", "LoadMicroBatch", "ReduceGrads", "ReduceTiedGrads", "OptimizerStep"]

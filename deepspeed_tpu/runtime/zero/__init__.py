@@ -1,0 +1,5 @@
+from .partition import (batch_specs, plan_grad_specs, plan_opt_state_specs, plan_param_specs, shard_leaf_spec,
+                        specs_to_shardings, zero_axes_for)
+
+__all__ = ["plan_param_specs", "plan_grad_specs", "plan_opt_state_specs", "shard_leaf_spec", "specs_to_shardings",
+           "batch_specs", "zero_axes_for"]
