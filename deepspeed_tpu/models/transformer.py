@@ -43,6 +43,13 @@ class TransformerConfig:
     dropout: float = 0.0
     remat: bool = False  # jax.checkpoint each block (activation checkpointing)
     scan_layers: bool = False  # lax.scan over layers (fast compile, pipeline-friendly)
+    # MoE (reference deepspeed/moe): >0 experts turns MLP slots into MoE layers
+    moe_num_experts: int = 0
+    moe_top_k: int = 1
+    moe_capacity_factor: float = 1.25
+    moe_layer_freq: int = 2  # every Nth block is MoE
+    moe_aux_loss_coef: float = 0.01
+    moe_min_capacity: int = 4
 
     @property
     def kv_heads(self) -> int:
@@ -163,6 +170,13 @@ class MLP(nn.Module):
 
 class Block(nn.Module):
     cfg: TransformerConfig
+    layer_idx: int = 0
+
+    @property
+    def is_moe(self) -> bool:
+        cfg = self.cfg
+        return cfg.moe_num_experts > 0 and (self.layer_idx % max(1, cfg.moe_layer_freq)
+                                            == max(1, cfg.moe_layer_freq) - 1)
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, segment_ids=None):
@@ -173,7 +187,16 @@ class Block(nn.Module):
         else:
             a, new_cache = attn(make_norm(cfg)(x), positions, None, segment_ids), None
         x = x + a
-        x = x + MLP(cfg, name="mlp")(make_norm(cfg)(x))
+        h = make_norm(cfg)(x)
+        if self.is_moe:
+            from ..moe.layer import MoE
+
+            mlp_out = MoE(hidden_size=cfg.d_model, num_experts=cfg.moe_num_experts, k=cfg.moe_top_k,
+                          capacity_factor=cfg.moe_capacity_factor, min_capacity=cfg.moe_min_capacity,
+                          d_ff=cfg.ffn_dim, activation=cfg.activation, dtype=cfg.dtype, name="moe")(h)
+        else:
+            mlp_out = MLP(cfg, name="mlp")(h)
+        x = x + mlp_out
         return (x, new_cache) if kv_cache is not None else x
 
 
@@ -202,7 +225,7 @@ class Transformer(nn.Module):
             x = self._scan_blocks(block_cls, x, positions, segment_ids)
         else:
             for i in range(cfg.n_layers):
-                blk = block_cls(cfg, name=f"layer_{i}")
+                blk = block_cls(cfg, layer_idx=i, name=f"layer_{i}")
                 if kv_caches is not None:
                     x, c = blk(x, positions, kv_caches[i], segment_ids)
                     new_caches.append(c)
@@ -264,17 +287,26 @@ class CausalLM:
 
     def loss_fn(self, params, batch, rng=None) -> jnp.ndarray:
         input_ids = batch["input_ids"]
-        logits = self.apply(params, input_ids)
+        if self.cfg.moe_num_experts > 0:
+            logits, mods = self.module.apply({"params": params}, input_ids, mutable=["losses", "intermediates"])
+            aux_leaves = jax.tree_util.tree_leaves(mods.get("losses", {}))
+            aux = sum(jnp.sum(l) for l in aux_leaves) if aux_leaves else 0.0
+        else:
+            logits = self.apply(params, input_ids)
+            aux = 0.0
         if "labels" in batch:
-            labels = batch["labels"]
-            return cross_entropy_loss(logits, labels)
-        return cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
+            ce = cross_entropy_loss(logits, batch["labels"])
+        else:
+            ce = cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
+        return ce + self.cfg.moe_aux_loss_coef * aux
 
     def partition_rules(self):
         """(path-substring tuple, PartitionSpec) TP sharding rules — the
         AutoTP-analogue metadata (column-parallel QKV/up, row-parallel o/down,
         vocab-sharded embeddings). Paths are flax param path tuples."""
-        return [
+        from ..moe.layer import MOE_PARTITION_RULES
+
+        return list(MOE_PARTITION_RULES) + [
             (("wte",), P("tensor", None)),
             (("wpe",), P(None, None)),
             (("q_proj", "kernel"), P(None, "tensor", None)),

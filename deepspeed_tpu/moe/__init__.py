@@ -1,0 +1,4 @@
+from .layer import Experts, MoE, MOE_PARTITION_RULES
+from .sharded_moe import combine_output, gate_and_dispatch, top1gating, topkgating
+
+__all__ = ["MoE", "Experts", "MOE_PARTITION_RULES", "top1gating", "topkgating", "gate_and_dispatch", "combine_output"]

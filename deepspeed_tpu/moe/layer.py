@@ -1,0 +1,125 @@
+"""MoE layer.
+
+Parity: reference ``deepspeed/moe/layer.py`` (``MoE`` wrapper :17,
+Residual MoE :30) + ``moe/experts.py`` (``Experts`` :13). Flax modules:
+``MoE`` drops into a transformer's MLP slot; expert weights carry a
+leading expert dimension sharded over the ``expert`` mesh axis (see
+``partition_rules`` in ``models/transformer.py`` and the generic rules
+here), which is what turns the dispatch einsums into all-to-alls under
+GSPMD. Aux loss is sown into the ``losses`` collection and collected by
+``CausalLM.loss_fn``.
+"""
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .sharded_moe import combine_output, gate_and_dispatch
+
+
+class Experts(nn.Module):
+    """E parallel FFN experts evaluated with batched einsums (MXU-friendly).
+
+    Reference ``moe/experts.py:13`` holds a ModuleList; here one stacked
+    param with a leading expert dim, sharded over ``expert``.
+    """
+
+    num_experts: int
+    d_model: int
+    d_ff: int
+    activation: str = "gelu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: (E, C, d)
+        E, d, f = self.num_experts, self.d_model, self.d_ff
+        init = nn.initializers.normal(0.02)
+        wi = self.param("wi", init, (E, d, f), jnp.float32)
+        wo = self.param("wo", init, (E, f, d), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", x, wi.astype(self.dtype))
+        if self.activation == "swiglu":
+            wg = self.param("wg", init, (E, d, f), jnp.float32)
+            g = jnp.einsum("ecd,edf->ecf", x, wg.astype(self.dtype))
+            h = nn.silu(g) * h
+        else:
+            h = nn.gelu(h)
+        return jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
+
+
+class MoE(nn.Module):
+    """Reference ``moe/layer.py:17``. Gated expert-parallel FFN layer.
+
+    Input (B, S, d) or (N, d); output same shape. The auxiliary
+    load-balancing loss is sown under ``('losses', 'moe_aux_loss')``.
+    """
+
+    hidden_size: int
+    num_experts: int = 8
+    ep_size: int = 1  # informational; actual EP degree = mesh 'expert' axis
+    k: int = 1
+    capacity_factor: float = 1.0
+    eval_capacity_factor: float = 1.0
+    min_capacity: int = 4
+    noisy_gate_policy: Optional[str] = None
+    drop_tokens: bool = True
+    use_residual: bool = False
+    d_ff: Optional[int] = None
+    activation: str = "gelu"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True, rng=None):
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        assert d == self.hidden_size
+        tokens = x.reshape(-1, d)
+
+        gate_logits = nn.Dense(self.num_experts, use_bias=False, name="gate", dtype=jnp.float32,
+                               param_dtype=jnp.float32)(tokens.astype(jnp.float32))
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        l_aux, dispatched, combine, exp_counts = gate_and_dispatch(
+            tokens, gate_logits, self.k, cf, self.min_capacity, rng=rng,
+            noisy_gate_policy=self.noisy_gate_policy if train else None, drop_tokens=self.drop_tokens)
+
+        # shard the expert dim -> XLA all-to-all over the expert mesh axis
+        dispatched = jax.lax.with_sharding_constraint(dispatched, P("expert", None, None)) \
+            if _mesh_has_axis("expert") else dispatched
+        expert_out = Experts(self.num_experts, d, self.d_ff or 4 * d, self.activation, self.dtype,
+                             name="experts")(dispatched)
+        expert_out = jax.lax.with_sharding_constraint(expert_out, P("expert", None, None)) \
+            if _mesh_has_axis("expert") else expert_out
+
+        out = combine_output(expert_out, combine).reshape(orig_shape).astype(x.dtype)
+
+        if self.use_residual:
+            # Residual MoE (reference layer.py:30): mix with a dense MLP branch
+            mlp_out = nn.Dense(d, use_bias=False, name="residual_mlp", dtype=self.dtype, param_dtype=jnp.float32)(
+                nn.gelu(nn.Dense(self.d_ff or 4 * d, use_bias=False, name="residual_mlp_in", dtype=self.dtype,
+                                 param_dtype=jnp.float32)(x)))
+            coef = nn.Dense(2, use_bias=False, name="coefficient", dtype=jnp.float32, param_dtype=jnp.float32)(
+                x.astype(jnp.float32))
+            coef = jax.nn.softmax(coef, axis=-1)
+            out = out * coef[..., 0:1].astype(x.dtype) + mlp_out * coef[..., 1:2].astype(x.dtype)
+
+        self.sow("losses", "moe_aux_loss", l_aux)
+        self.sow("intermediates", "exp_counts", exp_counts)
+        return out
+
+
+def _mesh_has_axis(axis: str) -> bool:
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        return mesh is not None and axis in (mesh.axis_names or ())
+    except Exception:
+        return False
+
+
+MOE_PARTITION_RULES = [
+    (("experts", "wi"), P("expert", None, None)),
+    (("experts", "wo"), P("expert", None, None)),
+    (("experts", "wg"), P("expert", None, None)),
+    (("gate", "kernel"), P(None, None)),
+]

@@ -1,0 +1,127 @@
+"""Top-k gating + expert dispatch.
+
+Parity: reference ``deepspeed/moe/sharded_moe.py`` (``TopKGate`` :372 with
+capacity/jitter, ``MOELayer`` :455: gate → dispatch einsum → all-to-all →
+experts → all-to-all → combine). The TPU-native formulation is the GShard
+einsum dispatch: one-hot dispatch/combine tensors contracted with the
+token batch, with the expert dimension sharded over the ``expert`` mesh
+axis so XLA lowers the dispatch/return into all-to-alls over ICI — no
+explicit ``all_to_all_single`` calls needed under GSPMD (the shard_map
+path in ``layer.py`` shows the explicit-collective equivalent).
+"""
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+uniform_map = {}
+
+
+def multiplicative_jitter(x: jnp.ndarray, rng, epsilon: float = 1e-2) -> jnp.ndarray:
+    """Reference ``sharded_moe.py`` jitter: multiply by U(1-eps, 1+eps)."""
+    if epsilon == 0 or rng is None:
+        return x
+    noise = jax.random.uniform(rng, x.shape, x.dtype, 1.0 - epsilon, 1.0 + epsilon)
+    return x * noise
+
+
+def _capacity(num_tokens: int, num_experts: int, capacity_factor: float, min_capacity: int, k: int) -> int:
+    cap = int(math.ceil(k * num_tokens / num_experts * capacity_factor))
+    return max(cap, min_capacity)
+
+
+def _one_hot(idx, n, dtype=jnp.float32):
+    return jax.nn.one_hot(idx, n, dtype=dtype)
+
+
+def top1gating(logits: jnp.ndarray, capacity_factor: float, min_capacity: int, rng=None,
+               noisy_gate_policy: Optional[str] = None, drop_tokens: bool = True,
+               used_token_mask: Optional[jnp.ndarray] = None):
+    """Top-1 (Switch) gating. logits: (N, E). Returns (l_aux, combine (N,E,C), dispatch (N,E,C), exp_counts)."""
+    N, E = logits.shape
+    C = _capacity(N, E, capacity_factor, min_capacity, k=1)
+    if noisy_gate_policy == "RSample" and rng is not None:
+        logits_w_noise = logits + jax.random.normal(rng, logits.shape, logits.dtype)
+    else:
+        logits_w_noise = logits
+    gates = jax.nn.softmax(logits, axis=-1)
+    expert_idx = jnp.argmax(logits_w_noise, axis=-1)  # (N,)
+    mask1 = _one_hot(expert_idx, E)  # (N, E)
+    if used_token_mask is not None:
+        mask1 = mask1 * used_token_mask[:, None]
+
+    # load-balancing loss (Switch): E * sum_e mean_prob_e * frac_tokens_e
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # position of each token within its expert's capacity
+    positions = jnp.cumsum(mask1, axis=0) - mask1  # (N, E), rank among tokens routed to e
+    pos_in_expert = jnp.sum(positions * mask1, axis=-1)  # (N,)
+    if drop_tokens:
+        keep = pos_in_expert < C
+        mask1 = mask1 * keep[:, None]
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    gate_val = jnp.sum(gates * mask1, axis=-1)  # (N,)
+    pos_oh = _one_hot(pos_in_expert.astype(jnp.int32), C)  # (N, C)
+    dispatch = (mask1[:, :, None] * pos_oh[:, None, :])  # (N, E, C)
+    combine = dispatch * gate_val[:, None, None]
+    return l_aux, combine, dispatch.astype(bool), exp_counts
+
+
+def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float, min_capacity: int, rng=None,
+               drop_tokens: bool = True, normalize_weights: bool = True):
+    """General top-k gating (k=2 reproduces GShard top-2). logits: (N, E)."""
+    N, E = logits.shape
+    C = _capacity(N, E, capacity_factor, min_capacity, k)
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # (N, k)
+    if normalize_weights:
+        topk_vals = topk_vals / jnp.maximum(jnp.sum(topk_vals, axis=-1, keepdims=True), 1e-9)
+
+    # aux loss over the top-1 assignment (reference uses mask of first choice)
+    mask1 = _one_hot(topk_idx[:, 0], E)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    combine = jnp.zeros((N, E, C), gates.dtype)
+    dispatch = jnp.zeros((N, E, C), bool)
+    # fill choices in priority order so earlier choices win capacity slots
+    occupancy = jnp.zeros((E,), jnp.int32)
+    for j in range(k):
+        idx_j = topk_idx[:, j]  # (N,)
+        mask_j = _one_hot(idx_j, E)  # (N, E)
+        pos_j = occupancy[None, :] + jnp.cumsum(mask_j, axis=0) - mask_j  # (N, E)
+        pos_in_expert = jnp.sum(pos_j * mask_j, axis=-1)
+        keep = (pos_in_expert < C) if drop_tokens else jnp.ones((N,), bool)
+        mask_j = mask_j * keep[:, None]
+        pos_oh = _one_hot(jnp.clip(pos_in_expert, 0, C - 1).astype(jnp.int32), C)
+        disp_j = mask_j[:, :, None] * pos_oh[:, None, :]
+        dispatch = dispatch | disp_j.astype(bool)
+        combine = combine + disp_j * topk_vals[:, j][:, None, None]
+        occupancy = occupancy + jnp.sum(mask_j, axis=0).astype(jnp.int32)
+    exp_counts = occupancy
+    return l_aux, combine, dispatch, exp_counts
+
+
+def gate_and_dispatch(x: jnp.ndarray, gate_logits: jnp.ndarray, k: int, capacity_factor: float,
+                      min_capacity: int, rng=None, noisy_gate_policy=None, drop_tokens=True):
+    """x: (N, d), gate_logits: (N, E) -> (l_aux, dispatched (E, C, d), combine (N, E, C), exp_counts)."""
+    if k == 1:
+        l_aux, combine, dispatch, exp_counts = top1gating(gate_logits, capacity_factor, min_capacity, rng,
+                                                          noisy_gate_policy, drop_tokens)
+    else:
+        l_aux, combine, dispatch, exp_counts = topkgating(gate_logits, k, capacity_factor, min_capacity, rng,
+                                                          drop_tokens)
+    dispatched = jnp.einsum("nec,nd->ecd", dispatch.astype(x.dtype), x)
+    return l_aux, dispatched, combine, exp_counts
+
+
+def combine_output(expert_out: jnp.ndarray, combine: jnp.ndarray) -> jnp.ndarray:
+    """expert_out: (E, C, d), combine: (N, E, C) -> (N, d)."""
+    return jnp.einsum("nec,ecd->nd", combine.astype(expert_out.dtype), expert_out)

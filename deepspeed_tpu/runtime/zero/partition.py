@@ -104,11 +104,11 @@ def plan_param_specs(param_shapes, config, topo, tp_rules=None):
     axes_size = int(np.prod([topo.axis_size(a) for a in axes]))
     threshold = config.zero_config.stage3_param_persistence_threshold
     rules = tp_rules or []
-    tp_on = topo.model_parallel_size > 1
 
     def leaf_spec(path, leaf):
         path_names = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        base = match_partition_rule(path_names, rules) if tp_on else None
+        # sharding over a size-1 mesh axis is a no-op, so rules always apply
+        base = match_partition_rule(path_names, rules)
         if stage == 3 and axes_size > 1:
             return shard_leaf_spec(tuple(leaf.shape), base, axes, axes_size, min_size=threshold)
         return base if base is not None else P()

@@ -1,0 +1,5 @@
+from .layer import DistributedAttention, single_all_to_all, ulysses_attention, ulysses_sharded_attention
+from .ring import ring_attention, ring_sharded_attention
+
+__all__ = ["DistributedAttention", "single_all_to_all", "ulysses_attention", "ulysses_sharded_attention",
+           "ring_attention", "ring_sharded_attention"]

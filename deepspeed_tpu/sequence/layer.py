@@ -1,0 +1,76 @@
+"""Sequence parallelism.
+
+Parity: reference ``deepspeed/sequence/layer.py`` — DeepSpeed-Ulysses:
+``single_all_to_all`` (:15), the autograd-symmetric ``_SeqAllToAll`` (:44)
+and ``DistributedAttention`` (:60), which wraps ANY local attention with an
+(seq -> head) all-to-all before and the inverse after, so each rank holds
+full sequences for a subset of heads during attention.
+
+TPU-native form: the all-to-all is ``lax.all_to_all`` over the ``seq``
+mesh axis inside ``shard_map``; autograd symmetry comes from JAX's
+transpose rule for ``all_to_all`` (no custom Function needed). The
+reference has NO ring attention (SURVEY §2.3); ``ring.py`` provides it as
+a superset for context parallelism over ICI.
+"""
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.attention import attention as default_attention
+
+
+def single_all_to_all(x: jnp.ndarray, scatter_idx: int, gather_idx: int, axis_name: str = "seq") -> jnp.ndarray:
+    """Split ``scatter_idx`` across the axis group, gather ``gather_idx``.
+
+    Reference ``sequence/layer.py:15``. Must run inside shard_map with
+    ``axis_name`` bound.
+    """
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx, concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """Reference ``sequence/layer.py:60``.
+
+    Wraps a local attention fn ``(q, k, v, **kw) -> out`` (shapes
+    (B, S, H, D)). Call inside shard_map where each member holds the
+    (B, S/P, H, D) sequence shard: heads are scattered and sequence
+    gathered for the attention, then reversed.
+    """
+
+    def __init__(self, local_attention: Optional[Callable] = None, sequence_process_group: str = "seq",
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention or default_attention
+        self.axis_name = sequence_process_group
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        s, g = self.scatter_idx, self.gather_idx
+        q = single_all_to_all(query, s, g, self.axis_name)
+        k = single_all_to_all(key, s, g, self.axis_name)
+        v = single_all_to_all(value, s, g, self.axis_name)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        # inverse: scatter seq back, gather heads
+        return single_all_to_all(out, g, s, self.axis_name)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", local_attention: Optional[Callable] = None, **kwargs):
+    """Functional form of DistributedAttention."""
+    return DistributedAttention(local_attention, axis_name)(q, k, v, **kwargs)
+
+
+def ulysses_sharded_attention(q, k, v, mesh, axis_name: str = "seq", **kwargs):
+    """Eager/jit wrapper: q,k,v are global arrays sharded (B, S@seq, H, D);
+    runs the Ulysses exchange + local attention under shard_map."""
+    spec = P(None, axis_name, None, None)
+
+    @partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    def fn(ql, kl, vl):
+        return ulysses_attention(ql, kl, vl, axis_name=axis_name, **kwargs)
+
+    return fn(q, k, v)
