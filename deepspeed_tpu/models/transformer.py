@@ -300,6 +300,72 @@ class CausalLM:
             ce = cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
         return ce + self.cfg.moe_aux_loss_coef * aux
 
+    def to_pipeline(self, num_stages: int, params=None, rng=None, example_batch=None):
+        """Split the model into (embed, S stacked stages, head) for the
+        pipeline engine. Stage params get a leading stage dim sharded over
+        the ``pipe`` mesh axis; each stage runs n_layers/num_stages blocks.
+
+        ``params``: existing parameter pytree to restructure (preferred);
+        otherwise freshly initialized from ``rng`` + ``example_batch``.
+        Returns (pipe_params, embed_fn, stage_fn, head_loss_fn, rules).
+        """
+        cfg = self.cfg
+        if cfg.n_layers % num_stages != 0:
+            raise ValueError(f"n_layers={cfg.n_layers} must divide evenly into {num_stages} pipeline stages")
+        if cfg.tie_embeddings:
+            raise ValueError("pipeline requires tie_embeddings=False (embed and head live on different stages)")
+        if cfg.moe_num_experts > 0:
+            raise NotImplementedError("MoE + pipeline composition lands with expert-parallel pipeline support")
+        if cfg.scan_layers:
+            raise ValueError("disable scan_layers for pipeline (stages are stacked instead)")
+        layers_per_stage = cfg.n_layers // num_stages
+
+        if params is None:
+            params = self.init(rng if rng is not None else jax.random.PRNGKey(0), example_batch)
+        embed_params = {"wte": params["wte"]}
+        if cfg.pos_emb == "learned":
+            embed_params["wpe"] = params["wpe"]
+        # stack block params: sub_j leaf -> (S, ...) over stages
+        stages = {}
+        for j in range(layers_per_stage):
+            per_stage = [params[f"layer_{s * layers_per_stage + j}"] for s in range(num_stages)]
+            stages[f"sub_{j}"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *per_stage)
+        head_params = {k: v for k, v in params.items()
+                       if not (k.startswith("layer_") or k in ("wte", "wpe"))}
+        pipe_params = {"embed": embed_params, "stages": stages, "head": head_params}
+
+        block = Block(cfg, layer_idx=0)
+        norm_key = [k for k in head_params if "Norm" in k]
+
+        def embed_fn(ep, input_ids):
+            B, S = input_ids.shape
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+            x = ep["wte"][input_ids].astype(cfg.dtype)
+            if cfg.pos_emb == "learned":
+                x = x + ep["wpe"][positions].astype(cfg.dtype)
+            return x
+
+        def stage_fn(sp, x):
+            B, S = x.shape[0], x.shape[1]
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+            for j in range(layers_per_stage):
+                x = block.apply({"params": sp[f"sub_{j}"]}, x, positions)
+            return x
+
+        def head_loss_fn(hp, x, labels_or_ids, labels_are_shifted: bool):
+            norm = make_norm(cfg)
+            x = norm.apply({"params": hp[norm_key[0]]}, x) if norm_key else x
+            logits = jnp.einsum("bsd,dv->bsv", x, hp["lm_head"]["kernel"].astype(cfg.dtype)).astype(jnp.float32)
+            if labels_are_shifted:
+                return cross_entropy_loss(logits, labels_or_ids)
+            return cross_entropy_loss(logits[:, :-1], labels_or_ids[:, 1:])
+
+        base_rules = self.partition_rules()
+        rules = [(("stages",) + key, P(*(("pipe",) + tuple(spec)))) for key, spec in base_rules]
+        rules += [(("stages",), P("pipe"))]
+        rules += base_rules
+        return pipe_params, embed_fn, stage_fn, head_loss_fn, rules
+
     def partition_rules(self):
         """(path-substring tuple, PartitionSpec) TP sharding rules — the
         AutoTP-analogue metadata (column-parallel QKV/up, row-parallel o/down,

@@ -148,8 +148,19 @@ _TOPOLOGY: Optional[MeshTopology] = None
 
 
 def initialize_mesh(config: Optional[MeshConfig] = None, devices=None, force: bool = False) -> MeshTopology:
-    """Build (or return) the global mesh. Reference: ``groups.initialize`` (``groups.py:52``)."""
+    """Build (or return) the global mesh. Reference: ``groups.initialize`` (``groups.py:52``).
+
+    Rebuilds if the requested axis sizes differ from the current mesh —
+    a new engine with a different parallel layout must not silently
+    inherit the old one.
+    """
     global _TOPOLOGY
+    if _TOPOLOGY is not None and not force and config is not None:
+        n = len(devices) if devices is not None else _TOPOLOGY.n_devices
+        requested = _resolve_axis_sizes(config, n)
+        if requested != _TOPOLOGY.axis_sizes:
+            logger.info(f"initialize_mesh: rebuilding mesh {_TOPOLOGY.axis_sizes} -> {requested}")
+            force = True
     if _TOPOLOGY is None or force:
         _TOPOLOGY = MeshTopology(config, devices)
     return _TOPOLOGY

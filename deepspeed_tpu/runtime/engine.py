@@ -479,14 +479,17 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None, tra
 
     from .pipe.module import PipelineModule
 
-    if isinstance(model, PipelineModule):
+    cfg = config if isinstance(config, DeepSpeedConfig) else DeepSpeedConfig(config)
+    wants_pipeline = isinstance(model, PipelineModule) or (cfg.mesh.pipe not in (0, 1)
+                                                           and hasattr(model, "to_pipeline"))
+    if wants_pipeline:
         from .pipe.engine import PipelineEngine
 
         engine = PipelineEngine(args=args, model=model, optimizer=optimizer, model_parameters=model_parameters,
                                 training_data=training_data, lr_scheduler=lr_scheduler, mesh=mesh,
-                                dist_init_required=dist_init_required, collate_fn=collate_fn, config=config, **kwargs)
+                                dist_init_required=dist_init_required, collate_fn=collate_fn, config=cfg, **kwargs)
     else:
         engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer, model_parameters=model_parameters,
                                  training_data=training_data, lr_scheduler=lr_scheduler, mesh=mesh,
-                                 dist_init_required=dist_init_required, collate_fn=collate_fn, config=config, **kwargs)
+                                 dist_init_required=dist_init_required, collate_fn=collate_fn, config=cfg, **kwargs)
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
