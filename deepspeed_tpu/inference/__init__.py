@@ -1,0 +1,4 @@
+from .config import DeepSpeedInferenceConfig
+from .engine import InferenceEngine, init_inference
+
+__all__ = ["InferenceEngine", "DeepSpeedInferenceConfig", "init_inference"]

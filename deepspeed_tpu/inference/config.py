@@ -1,0 +1,44 @@
+"""Inference config. Parity: reference ``deepspeed/inference/config.py``
+(``DeepSpeedInferenceConfig``)."""
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from ..runtime.config_utils import DeepSpeedConfigModel, ds_field
+
+
+@dataclass
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = ds_field(1, ge=1)
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+@dataclass
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    bits: int = 8
+    group_size: int = 64
+
+
+@dataclass
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    dtype: str = "bfloat16"  # float32 | float16 | bfloat16
+    tensor_parallel: DeepSpeedTPConfig = ds_field(default_factory=DeepSpeedTPConfig,
+                                                  aliases=["tp"])
+    max_out_tokens: int = ds_field(1024, ge=1, aliases=["max_tokens"])
+    min_out_tokens: int = ds_field(1, ge=1, aliases=["min_tokens"])
+    max_batch_size: int = ds_field(1, ge=1)
+    replace_with_kernel_inject: bool = ds_field(False, aliases=["kernel_inject"])
+    quant: QuantizationConfig = ds_field(default_factory=QuantizationConfig)
+    enable_cuda_graph: bool = False  # on TPU, jit IS the captured graph (accepted for parity)
+    checkpoint: Optional[str] = None
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = None
+
+    def jax_dtype(self):
+        import jax.numpy as jnp
+
+        return {"float32": jnp.float32, "fp32": jnp.float32, "float16": jnp.float16, "fp16": jnp.float16,
+                "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16}[self.dtype]

@@ -137,15 +137,17 @@ class Attention(nn.Module):
             k = apply_rope(k, cos, sin, positions)
 
         new_cache = None
+        kv_len = None
         if kv_cache is not None:
             # decode: append to cache at position offset
             ck, cv, cache_len = kv_cache
-            ck = jax.lax.dynamic_update_slice(ck, k, (0, cache_len, 0, 0))
-            cv = jax.lax.dynamic_update_slice(cv, v, (0, cache_len, 0, 0))
+            ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_len, 0, 0))
+            cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_len, 0, 0))
             k, v = ck, cv
-            new_cache = (ck, cv, cache_len + S)
+            kv_len = cache_len + S
+            new_cache = (ck, cv, kv_len)
 
-        out = attention(q, k, v, causal=True, segment_ids=segment_ids)
+        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len)
         out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.norm == "layernorm", name="o_proj",
                               dtype=cfg.dtype, param_dtype=jnp.float32)(out)
         return (out, new_cache) if kv_cache is not None else out
@@ -365,6 +367,13 @@ class CausalLM:
         rules += [(("stages",), P("pipe"))]
         rules += base_rules
         return pipe_params, embed_fn, stage_fn, head_loss_fn, rules
+
+    def init_kv_caches(self, batch_size: int, max_len: int, dtype=None):
+        """Preallocated per-layer KV caches for incremental decoding."""
+        cfg = self.cfg
+        dtype = dtype or cfg.dtype
+        zeros = lambda: jnp.zeros((batch_size, max_len, cfg.kv_heads, cfg.head_dim), dtype)
+        return [(zeros(), zeros(), jnp.asarray(0, jnp.int32)) for _ in range(cfg.n_layers)]
 
     def partition_rules(self):
         """(path-substring tuple, PartitionSpec) TP sharding rules — the

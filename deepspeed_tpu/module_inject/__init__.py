@@ -1,0 +1,3 @@
+from .auto_tp import AutoTP, get_tp_rules
+
+__all__ = ["AutoTP", "get_tp_rules"]

@@ -1,0 +1,100 @@
+"""AutoTP — policy-free tensor-parallel sharding inference.
+
+Parity: reference ``module_inject/auto_tp.py`` (``AutoTP.tp_parser`` :272,
+``_replace`` :323): walk the model, find linears, shard attention/MLP
+in-projections column-wise and out-projections row-wise, and insert the
+row-parallel all-reduce. On TPU the "replace" step is a set of
+PartitionSpecs over the ``tensor`` mesh axis (XLA inserts the
+reduce), so AutoTP reduces to *rule inference over the param pytree* —
+name/shape heuristics covering the common transformer vocabularies
+(HF gpt2/llama/bloom/falcon/t5 and this repo's models).
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.logging import logger
+
+# column-parallel: output features sharded (last dim(s) of a flax kernel)
+COLUMN_PATTERNS = [
+    "q_proj", "k_proj", "v_proj", "query", "key", "value", "c_attn", "query_key_value", "gate_proj", "up_proj",
+    "w1", "w3", "wi", "fc1", "fc_in", "dense_h_to_4h", "in_proj", "qkv_proj",
+]
+# row-parallel: input features sharded (first dim) + implicit all-reduce after
+ROW_PATTERNS = [
+    "o_proj", "out_proj", "c_proj", "down_proj", "w2", "wo", "fc2", "fc_out", "dense_4h_to_h", "attention.dense",
+]
+# vocab-sharded embeddings / unembeddings
+EMBED_PATTERNS = ["wte", "embed_tokens", "word_embeddings", "tok_embeddings", "lm_head", "embed_out"]
+# never shard
+SKIP_PATTERNS = ["wpe", "position_embedding", "norm", "ln_", "layernorm", "bias", "scale", "gate.kernel"]
+
+
+def _name_matches(path_str: str, patterns: Sequence[str]) -> bool:
+    return any(p in path_str for p in patterns)
+
+
+class AutoTP:
+    """Reference ``auto_tp.py`` class shape; ``tp_parser`` yields rules."""
+
+    def __init__(self, tp_size: int, tp_axis: str = "tensor"):
+        self.tp_size = tp_size
+        self.tp_axis = tp_axis
+
+    def _kernel_spec(self, shape: Tuple[int, ...], column: bool) -> Optional[P]:
+        ax = self.tp_axis
+        nd = len(shape)
+        if nd < 2:
+            return None
+        if column:
+            # flax Dense kernel: (in, out); DenseGeneral attn: (in, H, Dh)
+            if nd == 2 and shape[1] % self.tp_size == 0:
+                return P(None, ax)
+            if nd == 3 and shape[1] % self.tp_size == 0:
+                return P(None, ax, None)  # shard heads
+            if nd == 3 and shape[2] % self.tp_size == 0:
+                return P(None, None, ax)
+        else:
+            if nd == 2 and shape[0] % self.tp_size == 0:
+                return P(ax, None)
+            if nd == 3 and shape[0] % self.tp_size == 0:
+                return P(ax, None, None)  # o_proj DenseGeneral: (H, Dh, out)
+        return None
+
+    def tp_parser(self, params) -> List[Tuple[Tuple[str, ...], P]]:
+        """Infer (path, PartitionSpec) rules from a parameter pytree."""
+        rules: List[Tuple[Tuple[str, ...], P]] = []
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        for path, leaf in flat:
+            names = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+            path_str = ".".join(names).lower()
+            shape = tuple(getattr(leaf, "shape", ()))
+            if _name_matches(path_str, SKIP_PATTERNS) or len(shape) < 2:
+                continue
+            spec: Optional[P] = None
+            if _name_matches(path_str, EMBED_PATTERNS):
+                dim = 0 if shape[0] >= shape[-1] else len(shape) - 1  # vocab dim is the big one
+                if shape[dim] % self.tp_size == 0:
+                    entries = [None] * len(shape)
+                    entries[dim] = self.tp_axis
+                    spec = P(*entries)
+            elif _name_matches(path_str, ROW_PATTERNS):
+                spec = self._kernel_spec(shape, column=False)
+            elif _name_matches(path_str, COLUMN_PATTERNS):
+                spec = self._kernel_spec(shape, column=True)
+            if spec is not None:
+                rules.append((names, spec))
+        logger.info(f"AutoTP: inferred {len(rules)} tensor-parallel rules (tp={self.tp_size})")
+        return rules
+
+
+def get_tp_rules(params, tp_size: int, model=None) -> List[Tuple[Tuple[str, ...], P]]:
+    """Prefer model-provided rules (the 'injection policy' path,
+    reference ``replace_module.py:182``); fall back to AutoTP inference
+    (the no-policy path, ``replace_module.py:266``)."""
+    if model is not None and hasattr(model, "partition_rules"):
+        return model.partition_rules()
+    return AutoTP(tp_size).tp_parser(params)

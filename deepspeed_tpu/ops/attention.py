@@ -31,9 +31,12 @@ def attention_xla(q: jnp.ndarray,
                   causal: bool = True,
                   scale: Optional[float] = None,
                   bias: Optional[jnp.ndarray] = None,
-                  segment_ids: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                  segment_ids: Optional[jnp.ndarray] = None,
+                  kv_len=None) -> jnp.ndarray:
     """Multi-head attention, shapes (B, S, H, D) / KV may have fewer heads (GQA).
 
+    ``kv_len``: number of valid KV positions (for padded decode caches) —
+    queries are placed at absolute positions [kv_len - sq, kv_len).
     Computed in fp32 accumulation regardless of input dtype (softmax
     numerics), returned in the input dtype. XLA fuses the whole block.
     """
@@ -47,12 +50,16 @@ def attention_xla(q: jnp.ndarray,
     if bias is not None:
         logits = logits + bias
     sq, sk = q.shape[1], k.shape[1]
-    if causal:
-        # offset supports decode where q is a suffix of the kv sequence
-        offset = sk - sq
+    if causal or kv_len is not None:
+        # offset supports decode where q is a suffix of the (valid) kv sequence
+        valid = kv_len if kv_len is not None else sk
+        offset = valid - sq
         qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + offset
         ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        logits = jnp.where((ki <= qi)[None, None], logits, jnp.finfo(jnp.float32).min)
+        mask = ki < valid
+        if causal:
+            mask = mask & (ki <= qi)
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
     if segment_ids is not None:
         seg_q, seg_k = segment_ids if isinstance(segment_ids, tuple) else (segment_ids, segment_ids)
         mask = seg_q[:, :, None] == seg_k[:, None, :]

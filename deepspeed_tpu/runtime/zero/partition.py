@@ -52,6 +52,24 @@ def _axes_in_spec(spec: P) -> set:
     return used
 
 
+def prune_spec(spec: Optional[P], topo) -> Optional[P]:
+    """Drop axes of size 1 from a spec (they're no-ops that would block
+    further sharding of the dim by the ZeRO planner)."""
+    if spec is None:
+        return None
+
+    def keep(entry):
+        if entry is None:
+            return None
+        names = entry if isinstance(entry, (tuple, list)) else (entry,)
+        names = tuple(a for a in names if topo.axis_size(a) > 1)
+        if not names:
+            return None
+        return names if len(names) > 1 else names[0]
+
+    return _norm([keep(e) for e in spec])
+
+
 def match_partition_rule(path: Tuple[str, ...], rules: Sequence[Tuple[Tuple[str, ...], P]]) -> Optional[P]:
     """First rule whose key names all appear (in order) in the param path."""
     for key, spec in rules:
@@ -107,8 +125,7 @@ def plan_param_specs(param_shapes, config, topo, tp_rules=None):
 
     def leaf_spec(path, leaf):
         path_names = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        # sharding over a size-1 mesh axis is a no-op, so rules always apply
-        base = match_partition_rule(path_names, rules)
+        base = prune_spec(match_partition_rule(path_names, rules), topo)
         if stage == 3 and axes_size > 1:
             return shard_leaf_spec(tuple(leaf.shape), base, axes, axes_size, min_size=threshold)
         return base if base is not None else P()

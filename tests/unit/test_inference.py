@@ -1,0 +1,105 @@
+"""Inference v1 tests. Reference coverage model: ``tests/unit/inference/test_inference.py``
+(outputs validated against the uncached/unsharded oracle)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, TransformerConfig, llama_tiny
+from deepspeed_tpu.module_inject.auto_tp import AutoTP
+from jax.sharding import PartitionSpec as P
+
+
+def _model(vocab=128):
+    return CausalLM(TransformerConfig(vocab_size=vocab, n_layers=2, n_heads=4, d_model=64, max_seq_len=128,
+                                      norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False))
+
+
+def _greedy_no_cache(model, params, prompt, n_new):
+    """Oracle: recompute the full forward each step (no KV cache)."""
+    ids = jnp.asarray(prompt, jnp.int32)
+    for _ in range(n_new):
+        logits = model.apply(params, ids)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)[:, None]
+        ids = jnp.concatenate([ids, nxt], axis=1)
+    return ids
+
+
+def test_generate_matches_no_cache_oracle():
+    model = _model()
+    prompt = np.array([[5, 17, 3, 99, 4, 23, 7, 1]], dtype=np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+    engine = deepspeed_tpu.init_inference(model, {"dtype": "float32", "max_out_tokens": 64}, params=params)
+    out = engine.generate(prompt, max_new_tokens=8)
+    oracle = _greedy_no_cache(model, params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(oracle))
+
+
+def test_generate_tp_matches_single(mesh8):
+    model = _model()
+    prompt = np.array([[5, 17, 3, 99]], dtype=np.int32)
+    params = model.init(jax.random.PRNGKey(1), {"input_ids": prompt})
+
+    e1 = deepspeed_tpu.init_inference(model, {"dtype": "float32", "max_out_tokens": 32,
+                                              "tensor_parallel": {"tp_size": 1}}, params=params)
+    out1 = np.asarray(e1.generate(prompt, max_new_tokens=6))
+
+    e4 = deepspeed_tpu.init_inference(model, {"dtype": "float32", "max_out_tokens": 32,
+                                              "tensor_parallel": {"tp_size": 4}}, params=params)
+    # params actually sharded over tensor axis
+    qk = e4.params["layer_0"]["attn"]["q_proj"]["kernel"]
+    assert qk.addressable_shards[0].data.shape[1] == 1  # 4 heads / tp4
+    out4 = np.asarray(e4.generate(prompt, max_new_tokens=6))
+    np.testing.assert_array_equal(out1, out4)
+
+
+def test_generate_batch_and_eos():
+    model = _model()
+    prompt = np.array([[5, 17, 3, 99], [7, 2, 8, 11]], dtype=np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+    engine = deepspeed_tpu.init_inference(model, {"dtype": "float32", "max_out_tokens": 32}, params=params)
+    out = engine.generate(prompt, max_new_tokens=4)
+    assert out.shape == (2, 8)
+
+
+def test_sampling_is_seeded():
+    model = _model()
+    prompt = np.array([[5, 17, 3]], dtype=np.int32)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": prompt})
+    engine = deepspeed_tpu.init_inference(model, {"dtype": "float32", "max_out_tokens": 32}, params=params)
+    a = np.asarray(engine.generate(prompt, max_new_tokens=5, do_sample=True, temperature=1.5, seed=3))
+    b = np.asarray(engine.generate(prompt, max_new_tokens=5, do_sample=True, temperature=1.5, seed=3))
+    c = np.asarray(engine.generate(prompt, max_new_tokens=5, do_sample=True, temperature=1.5, seed=4))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c) or True  # different seed usually differs; no hard guarantee
+
+
+def test_autotp_rules_hf_names():
+    """AutoTP heuristics over an HF-llama-shaped pytree."""
+    fake = {
+        "model": {
+            "embed_tokens": {"embedding": jnp.zeros((32000, 64))},
+            "layers_0": {
+                "self_attn": {
+                    "q_proj": {"kernel": jnp.zeros((64, 64))},
+                    "o_proj": {"kernel": jnp.zeros((64, 64))},
+                },
+                "mlp": {
+                    "gate_proj": {"kernel": jnp.zeros((64, 256))},
+                    "down_proj": {"kernel": jnp.zeros((256, 64))},
+                },
+                "input_layernorm": {"scale": jnp.zeros((64,))},
+            },
+        },
+        "lm_head": {"kernel": jnp.zeros((64, 32000))},
+    }
+    rules = dict(AutoTP(4).tp_parser(fake))
+    assert rules[("model", "layers_0", "self_attn", "q_proj", "kernel")] == P(None, "tensor")
+    assert rules[("model", "layers_0", "self_attn", "o_proj", "kernel")] == P("tensor", None)
+    assert rules[("model", "layers_0", "mlp", "gate_proj", "kernel")] == P(None, "tensor")
+    assert rules[("model", "layers_0", "mlp", "down_proj", "kernel")] == P("tensor", None)
+    assert rules[("model", "embed_tokens", "embedding")] == P("tensor", None)
+    assert rules[("lm_head", "kernel")] == P(None, "tensor")
+    assert ("model", "layers_0", "input_layernorm", "scale") not in rules
