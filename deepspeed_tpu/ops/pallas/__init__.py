@@ -1,0 +1,13 @@
+"""Pallas TPU kernels. Importing registers them with the op registry at
+higher priority than the XLA fallbacks; selection is per-op via
+availability probing (real TPU backend) or DS_TPU_OP_* env overrides."""
+
+from . import flash_attention, fused_adam, norms, quantization  # noqa: F401
+
+from .flash_attention import flash_attention as flash_attention_fn
+from .fused_adam import fused_adam_flat
+from .norms import layer_norm, rms_norm
+from .quantization import cast_fp8, dequantize_groupwise, quantize_groupwise
+
+__all__ = ["flash_attention_fn", "fused_adam_flat", "rms_norm", "layer_norm", "quantize_groupwise",
+           "dequantize_groupwise", "cast_fp8"]

@@ -1,0 +1,274 @@
+"""Pallas flash attention (TPU).
+
+Capability parity: the reference's fused attention kernels
+(``csrc/transformer/inference/csrc/softmax.cu``, training softmax/
+transform kernels in ``csrc/transformer``, blocked flash in
+``inference/v2/kernels/ragged_ops/blocked_flash``). On TPU the win is the
+same as on GPU: never materialize the (S, S) probability matrix in HBM —
+blocked online softmax in VMEM feeding the MXU.
+
+Forward and backward are both Pallas kernels, stitched with
+``jax.custom_vjp``. Layout: inputs (B, S, H, D) are transposed to
+(B, H, S, D); grid is (B*H, Sq/bq) for fwd/dq and (B*H, Sk/bk) for dkv.
+GQA is handled by expanding KV heads before the kernel (XLA broadcasts —
+no copy until use).
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..registry import REGISTRY, pallas_available
+
+NEG_INF = -1e30
+DEFAULT_BLOCK = 128
+LANES = 128  # min lane width for fp32 stores (canonical TPU l/m layout)
+
+
+def _blk(seq: int, want: int = DEFAULT_BLOCK) -> int:
+    b = min(seq, want)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_k: int, scale: float, causal: bool):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale  # (bq, D)
+    D = q.shape[-1]
+
+    nk = seq_k // bk
+    if causal:
+        # last kv block that any row of this q block can see (qi is traced)
+        nk = jnp.minimum(pl.cdiv((qi + 1) * bq, bk), seq_k // bk)
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)  # (bk, D)
+        v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        bmax = jnp.max(s, axis=-1)
+        new_m = jnp.maximum(m, bmax)
+        p = jnp.exp(s - new_m[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        corr = jnp.exp(m - new_m)
+        new_l = l * corr + jnp.sum(p, axis=-1)
+        new_acc = acc * corr[:, None] + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+        return new_acc, new_m, new_l
+
+    acc0 = jnp.zeros((bq, D), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse = (m + jnp.log(l_safe)).astype(jnp.float32)
+    lse_ref[0] = jax.lax.broadcast_in_dim(lse, (lse.shape[0], LANES), (0,))
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _blk(Sq), _blk(Sk)
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_k=Sk, scale=scale, causal=causal)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(BH, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ----------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, bk, seq_k, scale, causal):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    D = q.shape[-1]
+
+    nk = seq_k // bk
+    if causal:
+        nk = jnp.minimum(pl.cdiv((qi + 1) * bq, bk), nk)
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+        if causal:
+            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (bq, bk)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+
+    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, bq, bk, seq_q, scale, causal):
+    kj = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)
+    v = v_ref[0].astype(jnp.float32)
+    D = k.shape[-1]
+
+    nq = seq_q // bq
+    start = 0
+    if causal:
+        start = (kj * bk) // bq  # first q block that can see this kv block
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.dslice(i * bq, bq), 0]
+        delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # (bq, bk)
+        if causal:
+            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(cols <= rows, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))  # (bk, D)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))  # (bk, D)
+        return dk, dv
+
+    dk0 = jnp.zeros((bk, D), jnp.float32)
+    dv0 = jnp.zeros((bk, D), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool):
+    BH, Sq, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _blk(Sq), _blk(Sk)
+    delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (BH, Sq)
+    delta = jnp.broadcast_to(delta[..., None], (BH, Sq, LANES))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, bq=bq, bk=bk, seq_k=Sk, scale=scale, causal=causal),
+        grid=(BH, Sq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, scale=scale, causal=causal),
+        grid=(BH, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# public op: (B, S, H, D) layout + GQA + custom_vjp
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, interpret):
+    o, _ = _flash_core(q, k, v, scale, causal, interpret)
+    return o
+
+
+def _flash_core(q, k, v, scale, causal, interpret):
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
+    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), scale, causal, interpret)
+    o = o.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
+    return o, lse
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal, interpret):
+    o, lse = _flash_core(q, k, v, scale, causal, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, interpret, res, do):
+    q, k, v, o, lse = res
+    B, Sq, H, D = q.shape
+    to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
+    dq, dk, dv = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do), scale, causal, interpret)
+    back = lambda x, S: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
+    return back(dq, Sq), back(dk, k.shape[1]), back(dv, k.shape[1])
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None, bias=None, segment_ids=None,
+                    kv_len=None, interpret: bool = False):
+    """Drop-in for ``attention_xla`` on the fast path; falls back to XLA for
+    features the kernel doesn't cover (bias, segments, padded kv)."""
+    if bias is not None or segment_ids is not None or kv_len is not None:
+        from ..attention import attention_xla
+
+        return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids, kv_len=kv_len)
+    n_rep = q.shape[2] // k.shape[2]
+    if n_rep > 1:
+        b, s, h, d = k.shape
+        k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+        v = jnp.broadcast_to(v[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
+    scale = scale if scale is not None else 1.0 / (q.shape[-1]**0.5)
+    return _flash(q, k, v, scale, causal, interpret)
+
+
+REGISTRY.register("attention", "pallas", flash_attention, is_available=pallas_available, priority=10)

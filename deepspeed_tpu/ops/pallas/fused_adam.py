@@ -1,0 +1,84 @@
+"""Pallas fused Adam.
+
+Capability parity: reference ``csrc/adam/multi_tensor_adam.cu`` (FusedAdam
+over chunked tensor lists). On TPU the pytree is flattened into one 1-D
+buffer per state and a single elementwise kernel updates param/exp_avg/
+exp_avg_sq in one pass over VMEM blocks (XLA would fuse this anyway — the
+explicit kernel mirrors the reference capability and pins the fusion).
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import REGISTRY, pallas_available
+
+
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, out_p, out_m, out_v, *, b1, b2, eps, wd, step_bias1, step_bias2):
+    p = p_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    m = m_ref[...].astype(jnp.float32)
+    v = v_ref[...].astype(jnp.float32)
+    lr = lr_ref[0]
+    new_m = b1 * m + (1.0 - b1) * g
+    new_v = b2 * v + (1.0 - b2) * g * g
+    mhat = new_m / step_bias1
+    vhat = new_v / step_bias2
+    update = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    out_p[...] = (p - lr * update).astype(out_p.dtype)
+    out_m[...] = new_m.astype(out_m.dtype)
+    out_v[...] = new_v.astype(out_v.dtype)
+
+
+def fused_adam_flat(p, g, m, v, lr, step: int, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+                    block: int = 1 << 16, interpret: bool = False):
+    """One fused AdamW update over flat 1-D buffers. ``step`` is 1-based."""
+    n = p.size
+    pad = (-n) % block
+    padded = lambda x: jnp.pad(x.reshape(-1), (0, pad)) if pad else x.reshape(-1)
+    pp, gg, mm, vv = padded(p), padded(g), padded(m), padded(v)
+    lr_arr = jnp.asarray([lr], jnp.float32)
+    kernel = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=weight_decay,
+                               step_bias1=1.0 - b1**step, step_bias2=1.0 - b2**step)
+    np_, nm_, nv_ = pl.pallas_call(
+        kernel,
+        grid=(pp.size // block,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((block,), lambda i: (i,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(pp.shape, p.dtype),
+            jax.ShapeDtypeStruct(mm.shape, m.dtype),
+            jax.ShapeDtypeStruct(vv.shape, v.dtype),
+        ],
+        interpret=interpret,
+    )(pp, gg, mm, vv, lr_arr)
+    unpad = lambda x, ref: x[:n].reshape(ref.shape)
+    return unpad(np_, p), unpad(nm_, m), unpad(nv_, v)
+
+
+REGISTRY.register("fused_adam", "pallas", fused_adam_flat, is_available=pallas_available, priority=10)
+
+
+def adam_xla(p, g, m, v, lr, step, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0, **_):
+    new_m = b1 * m + (1 - b1) * g
+    new_v = b2 * v + (1 - b2) * g * g
+    mhat = new_m / (1 - b1**step)
+    vhat = new_v / (1 - b2**step)
+    return p - lr * (mhat / (jnp.sqrt(vhat) + eps) + weight_decay * p), new_m, new_v
+
+
+REGISTRY.register("fused_adam", "xla", adam_xla, priority=0)

@@ -1,0 +1,102 @@
+"""Pallas group-wise quantization kernels.
+
+Capability parity: reference ``csrc/quantization/`` — symmetric group-wise
+int8/int4 quant/dequant (``quantize.cu``, ``quantize_intX.cu``) used by
+ZeRO++ qwZ (quantized weight allgather) and qgZ (quantized gradient
+reduce), plus fp8 casts (``csrc/fp_quantizer``, native fp8 dtypes on TPU).
+The quantized-collective compositions live in
+``runtime/comm/quantized.py``.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..registry import REGISTRY, pallas_available
+
+
+def _quant_kernel(x_ref, q_ref, s_ref, *, bits):
+    x = x_ref[...].astype(jnp.float32)  # (rows, group)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    qmax = float(2**(bits - 1) - 1)
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x / scale), -qmax - 1, qmax)
+    q_ref[...] = q.astype(jnp.int8)
+    s_ref[...] = scale.astype(jnp.float32)  # (rows, 1)
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref):
+    q = q_ref[...].astype(jnp.float32)
+    s = s_ref[...].astype(jnp.float32)  # (rows, 1)
+    o_ref[...] = (q * s).astype(o_ref.dtype)
+
+
+def _rows_block(n_rows: int, want: int = 512) -> int:
+    b = min(n_rows, want)
+    while n_rows % b:
+        b //= 2
+    return max(b, 1)
+
+
+def quantize_groupwise(x, group_size: int = 128, bits: int = 8, interpret: bool = False):
+    """x: any shape, size divisible by group_size. Returns (int8 q, fp32 scales)."""
+    n = x.size
+    assert n % group_size == 0, f"size {n} not divisible by group {group_size}"
+    rows = n // group_size
+    x2 = x.reshape(rows, group_size)
+    rb = _rows_block(rows)
+    q, s = pl.pallas_call(
+        functools.partial(_quant_kernel, bits=bits),
+        grid=(rows // rb,),
+        in_specs=[pl.BlockSpec((rb, group_size), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((rb, group_size), lambda i: (i, 0)), pl.BlockSpec((rb, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((rows, group_size), jnp.int8),
+                   jax.ShapeDtypeStruct((rows, 1), jnp.float32)],
+        interpret=interpret,
+    )(x2)
+    return q, s[:, 0]
+
+
+def dequantize_groupwise(q, scales, out_shape=None, out_dtype=jnp.float32, interpret: bool = False):
+    rows, group = q.shape
+    rb = _rows_block(rows)
+    out = pl.pallas_call(
+        _dequant_kernel,
+        grid=(rows // rb,),
+        in_specs=[pl.BlockSpec((rb, group), lambda i: (i, 0)), pl.BlockSpec((rb, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rb, group), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, group), out_dtype),
+        interpret=interpret,
+    )(q, scales[:, None])
+    return out.reshape(out_shape) if out_shape is not None else out
+
+
+def quantize_groupwise_xla(x, group_size: int = 128, bits: int = 8, **_):
+    n = x.size
+    rows = n // group_size
+    x2 = x.reshape(rows, group_size).astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    qmax = float(2**(bits - 1) - 1)
+    scale = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(x2 / scale), -qmax - 1, qmax).astype(jnp.int8)
+    return q, scale[:, 0]
+
+
+def dequantize_groupwise_xla(q, scales, out_shape=None, out_dtype=jnp.float32, **_):
+    out = (q.astype(jnp.float32) * scales[:, None]).astype(out_dtype)
+    return out.reshape(out_shape) if out_shape is not None else out
+
+
+def cast_fp8(x, dtype="e4m3"):
+    """fp8 cast (TPU-native fp8 dtypes) — the fp_quantizer analogue."""
+    target = jnp.float8_e4m3fn if dtype == "e4m3" else jnp.float8_e5m2
+    return x.astype(target)
+
+
+REGISTRY.register("quantize", "pallas", quantize_groupwise, is_available=pallas_available, priority=10)
+REGISTRY.register("quantize", "xla", quantize_groupwise_xla, priority=0)
+REGISTRY.register("dequantize", "pallas", dequantize_groupwise, is_available=pallas_available, priority=10)
+REGISTRY.register("dequantize", "xla", dequantize_groupwise_xla, priority=0)
