@@ -1,0 +1,28 @@
+"""Universal checkpointing.
+
+Parity target: reference ``deepspeed/checkpoint/`` (``ds_to_universal.py``,
+``universal_checkpoint.py``) and ``deepspeed/utils/zero_to_fp32.py``. The
+reference must *merge* per-rank ZeRO shards and TP slices offline
+(``ds_to_universal.py:92 extract_zero_shards``, ``:189 merge_tp_slices``)
+because its on-disk layout is rank-sliced. The TPU-native engine saves
+full (sharding-agnostic) host trees, so here the universal format is a
+re-layout into per-parameter fp32 slices — and *loading* at any
+(dp, fsdp, tensor, pipe) degree is a ``device_put`` against the target
+mesh's shardings.
+"""
+
+from .universal import (UNIVERSAL_CHECKPOINT_INFO, ds_to_universal, inspect_universal_checkpoint,
+                        load_universal_checkpoint, save_universal_checkpoint)
+from .zero_to_fp32 import (convert_zero_checkpoint_to_fp32_state_dict, get_fp32_state_dict_from_zero_checkpoint,
+                           load_state_dict_from_zero_checkpoint)
+
+__all__ = [
+    "UNIVERSAL_CHECKPOINT_INFO",
+    "ds_to_universal",
+    "save_universal_checkpoint",
+    "load_universal_checkpoint",
+    "inspect_universal_checkpoint",
+    "get_fp32_state_dict_from_zero_checkpoint",
+    "convert_zero_checkpoint_to_fp32_state_dict",
+    "load_state_dict_from_zero_checkpoint",
+]

@@ -1,0 +1,107 @@
+"""Canonical flat naming for checkpoint trees.
+
+Both live pytrees (dicts / optax namedtuples) and msgpack-restored state
+dicts are first normalised through ``flax.serialization.to_state_dict``
+(pure nested dicts with string keys), then flattened to
+``"a/b/0/kernel" -> leaf`` with numeric-aware key ordering, so names and
+leaf *order* are identical whether the tree came from a live engine or
+from disk. This replaces the reference's param↔fragment mapping machinery
+(``deepspeed/utils/tensor_fragment.py``) — with full tensors on disk no
+fragment offsets are needed.
+"""
+
+from typing import Any, Dict, Iterator, List, Tuple
+
+import numpy as np
+
+SEP = "/"
+
+
+def to_state_dict(tree) -> Any:
+    from flax import serialization
+
+    return serialization.to_state_dict(tree)
+
+
+def from_state_dict(template, state_dict):
+    from flax import serialization
+
+    return serialization.from_state_dict(template, state_dict)
+
+
+def _sorted_keys(d: Dict) -> List[str]:
+    """Numeric-aware ordering so list index keys '2' < '10'."""
+
+    def key(k: str):
+        return (0, int(k), "") if str(k).isdigit() else (1, 0, str(k))
+
+    return sorted(d.keys(), key=key)
+
+
+def iter_named_leaves(node, prefix: Tuple[str, ...] = ()) -> Iterator[Tuple[str, Any]]:
+    if isinstance(node, dict):
+        for k in _sorted_keys(node):
+            yield from iter_named_leaves(node[k], prefix + (str(k),))
+    else:
+        yield SEP.join(prefix), node
+
+
+def flat_named_leaves(tree) -> Dict[str, Any]:
+    """``{canonical_name: leaf}`` for any pytree (normalised first)."""
+    return dict(iter_named_leaves(to_state_dict(tree)))
+
+
+def unflatten_named(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Inverse of :func:`iter_named_leaves` back into a nested state dict."""
+    nested: Dict[str, Any] = {}
+    for name, leaf in flat.items():
+        parts = name.split(SEP)
+        d = nested
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = leaf
+    return nested
+
+
+def leaf_signature(node) -> Tuple[Tuple[str, Tuple[int, ...]], ...]:
+    """Sorted (name, shape) tuple identifying a subtree's array layout."""
+    out = []
+    for name, leaf in iter_named_leaves(to_state_dict(node)):
+        shape = tuple(getattr(leaf, "shape", ()))
+        out.append((name, shape))
+    return tuple(sorted(out))
+
+
+def find_param_shaped_subtrees(state_dict, param_signature) -> List[Tuple[str, ...]]:
+    """DFS (sorted-key order) paths of subtrees whose leaf signature equals
+    the parameter tree's — e.g. Adam's ``mu``/``nu`` inside an optax state."""
+    found: List[Tuple[str, ...]] = []
+
+    def rec(node, path: Tuple[str, ...]):
+        if isinstance(node, dict):
+            if leaf_signature(node) == param_signature:
+                found.append(path)
+                return
+            for k in _sorted_keys(node):
+                rec(node[k], path + (str(k),))
+
+    rec(state_dict, ())
+    return found
+
+
+def get_subtree(state_dict, path: Tuple[str, ...]):
+    node = state_dict
+    for p in path:
+        node = node[p]
+    return node
+
+
+def set_subtree(state_dict, path: Tuple[str, ...], value):
+    node = state_dict
+    for p in path[:-1]:
+        node = node[p]
+    node[path[-1]] = value
+
+
+def is_scalar_like(leaf) -> bool:
+    return np.ndim(leaf) == 0

@@ -1,0 +1,68 @@
+"""Reconstruct a plain fp32 state dict from an engine checkpoint.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py`` (``get_fp32_state_dict_
+from_zero_checkpoint`` :541, ``convert_zero_checkpoint_to_fp32_state_dict``
+:524) — there, per-dp-rank flattened ZeRO shards must be stitched back
+into parameter tensors. The TPU engine's native checkpoint already holds
+full fp32 masters, so this module is the same *user contract* (offline
+export for inference / HF upload) over a trivial read.
+
+CLI:  python -m deepspeed_tpu.checkpoint.zero_to_fp32 <ckpt_dir> <out_file>
+"""
+
+import argparse
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .universal import LATEST_FILENAME, MODEL_STATES_FILENAME, _load_native, _resolve_tag
+from .utils import flat_named_leaves, from_state_dict
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag: Optional[str] = None) -> Dict[str, Any]:
+    """Nested fp32 state dict (numpy leaves) from a native checkpoint."""
+    tag = _resolve_tag(checkpoint_dir, tag)
+    params_sd, _ = _load_native(checkpoint_dir, tag)
+
+    def cast(x):
+        return np.asarray(x, dtype=np.float32) if hasattr(x, "dtype") else x
+
+    import jax
+
+    return jax.tree_util.tree_map(cast, params_sd)
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
+                                               tag: Optional[str] = None) -> str:
+    """Write the fp32 state dict to ``output_file`` (msgpack via flax)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    from flax import serialization
+
+    os.makedirs(os.path.dirname(os.path.abspath(output_file)), exist_ok=True)
+    with open(output_file, "wb") as f:
+        f.write(serialization.to_bytes(sd))
+    n = len(flat_named_leaves(sd))
+    logger.info(f"fp32 state dict with {n} tensors written to {output_file}")
+    return output_file
+
+
+def load_state_dict_from_zero_checkpoint(template, checkpoint_dir: str, tag: Optional[str] = None):
+    """Restore the fp32 state dict into ``template``'s pytree structure."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    return from_state_dict(template, sd)
+
+
+def main():
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("checkpoint_dir", help="engine checkpoint directory (contains 'latest')")
+    parser.add_argument("output_file", help="path for the fp32 state dict (msgpack)")
+    parser.add_argument("-t", "--tag", default=None, help="checkpoint tag (default: read 'latest')")
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir, args.output_file, args.tag)
+
+
+if __name__ == "__main__":
+    main()

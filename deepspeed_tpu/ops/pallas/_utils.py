@@ -1,0 +1,9 @@
+"""Shared Pallas kernel helpers."""
+
+
+def block_that_divides(n: int, want: int) -> int:
+    """Largest power-of-two-reduced block <= ``want`` that divides ``n``."""
+    b = min(n, want)
+    while n % b:
+        b //= 2
+    return max(b, 1)

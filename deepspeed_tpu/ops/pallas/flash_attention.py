@@ -22,6 +22,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import REGISTRY, pallas_available
+from ._utils import block_that_divides
 
 NEG_INF = -1e30
 DEFAULT_BLOCK = 128
@@ -29,24 +30,24 @@ LANES = 128  # min lane width for fp32 stores (canonical TPU l/m layout)
 
 
 def _blk(seq: int, want: int = DEFAULT_BLOCK) -> int:
-    b = min(seq, want)
-    while seq % b:
-        b //= 2
-    return max(b, 1)
+    return block_that_divides(seq, want)
 
 
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_k: int, scale: float, causal: bool):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int, seq_k: int, scale: float,
+                causal: bool):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale  # (bq, D)
     D = q.shape[-1]
 
+    # queries align to the END of the kv sequence (matches attention_xla)
+    offset = seq_k - seq_q
     nk = seq_k // bk
     if causal:
         # last kv block that any row of this q block can see (qi is traced)
-        nk = jnp.minimum(pl.cdiv((qi + 1) * bq, bk), seq_k // bk)
+        nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), seq_k // bk)
 
     def body(j, carry):
         acc, m, l = carry
@@ -54,7 +55,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_k:
         v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk)
         if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         bmax = jnp.max(s, axis=-1)
@@ -80,7 +81,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq), _blk(Sk)
-    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_k=Sk, scale=scale, causal=causal)
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // bq),
@@ -105,7 +106,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
 # ----------------------------------------------------------------------
 # backward
 # ----------------------------------------------------------------------
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, bk, seq_k, scale, causal):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, bk, seq_q, seq_k, scale, causal):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
@@ -113,16 +114,17 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, b
     delta = delta_ref[0, :, 0]
     D = q.shape[-1]
 
+    offset = seq_k - seq_q
     nk = seq_k // bk
     if causal:
-        nk = jnp.minimum(pl.cdiv((qi + 1) * bq, bk), nk)
+        nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), nk)
 
     def body(j, dq):
         k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
         if causal:
-            rows = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
@@ -135,16 +137,19 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, b
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, bq, bk, seq_q, scale, causal):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, bq, bk, seq_q, seq_k, scale,
+                causal):
     kj = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)
     v = v_ref[0].astype(jnp.float32)
     D = k.shape[-1]
 
+    offset = seq_k - seq_q
     nq = seq_q // bq
     start = 0
     if causal:
-        start = (kj * bk) // bq  # first q block that can see this kv block
+        # first q block that can see this kv block (row offset+r sees col c iff c <= offset+r)
+        start = jnp.maximum(kj * bk - offset, 0) // bq
 
     def body(i, carry):
         dk, dv = carry
@@ -154,7 +159,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # (bq, bk)
         if causal:
-            rows = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            rows = offset + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
@@ -180,7 +185,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool)
     delta = jnp.broadcast_to(delta[..., None], (BH, Sq, LANES))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, bq=bq, bk=bk, seq_k=Sk, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal),
         grid=(BH, Sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
@@ -196,7 +201,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool)
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal),
         grid=(BH, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),

@@ -18,12 +18,14 @@ from jax.experimental.pallas import tpu as pltpu
 from ..registry import REGISTRY, pallas_available
 
 
-def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, out_p, out_m, out_v, *, b1, b2, eps, wd, step_bias1, step_bias2):
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, scalars_ref, out_p, out_m, out_v, *, b1, b2, eps, wd):
     p = p_ref[...].astype(jnp.float32)
     g = g_ref[...].astype(jnp.float32)
     m = m_ref[...].astype(jnp.float32)
     v = v_ref[...].astype(jnp.float32)
-    lr = lr_ref[0]
+    lr = scalars_ref[0]
+    step_bias1 = scalars_ref[1]
+    step_bias2 = scalars_ref[2]
     new_m = b1 * m + (1.0 - b1) * g
     new_v = b2 * v + (1.0 - b2) * g * g
     mhat = new_m / step_bias1
@@ -34,16 +36,18 @@ def _adam_kernel(p_ref, g_ref, m_ref, v_ref, lr_ref, out_p, out_m, out_v, *, b1,
     out_v[...] = new_v.astype(out_v.dtype)
 
 
-def fused_adam_flat(p, g, m, v, lr, step: int, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
+def fused_adam_flat(p, g, m, v, lr, step, b1=0.9, b2=0.999, eps=1e-8, weight_decay=0.0,
                     block: int = 1 << 16, interpret: bool = False):
-    """One fused AdamW update over flat 1-D buffers. ``step`` is 1-based."""
+    """One fused AdamW update over flat 1-D buffers. ``step`` is 1-based and
+    may be a traced array — bias-correction terms ride in SMEM with lr, so
+    the kernel compiles once and serves every step."""
     n = p.size
     pad = (-n) % block
     padded = lambda x: jnp.pad(x.reshape(-1), (0, pad)) if pad else x.reshape(-1)
     pp, gg, mm, vv = padded(p), padded(g), padded(m), padded(v)
-    lr_arr = jnp.asarray([lr], jnp.float32)
-    kernel = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=weight_decay,
-                               step_bias1=1.0 - b1**step, step_bias2=1.0 - b2**step)
+    stepf = jnp.asarray(step, jnp.float32)
+    scalars = jnp.stack([jnp.asarray(lr, jnp.float32), 1.0 - b1**stepf, 1.0 - b2**stepf])
+    kernel = functools.partial(_adam_kernel, b1=b1, b2=b2, eps=eps, wd=weight_decay)
     np_, nm_, nv_ = pl.pallas_call(
         kernel,
         grid=(pp.size // block,),
@@ -65,7 +69,7 @@ def fused_adam_flat(p, g, m, v, lr, step: int, b1=0.9, b2=0.999, eps=1e-8, weigh
             jax.ShapeDtypeStruct(vv.shape, v.dtype),
         ],
         interpret=interpret,
-    )(pp, gg, mm, vv, lr_arr)
+    )(pp, gg, mm, vv, scalars)
     unpad = lambda x, ref: x[:n].reshape(ref.shape)
     return unpad(np_, p), unpad(nm_, m), unpad(nv_, v)
 

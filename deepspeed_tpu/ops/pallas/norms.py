@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import REGISTRY, pallas_available
+from ._utils import block_that_divides
 
 
 def _rms_kernel(x_ref, w_ref, o_ref, *, eps):
@@ -33,13 +34,10 @@ def _ln_kernel(x_ref, w_ref, b_ref, o_ref, *, eps):
 
 
 def _rows_block(n_rows: int, want: int = 256) -> int:
-    b = min(n_rows, want)
-    while n_rows % b:
-        b //= 2
-    return max(b, 1)
+    return block_that_divides(n_rows, want)
 
 
-def rms_norm(x, weight, eps: float = 1e-5, interpret: bool = False):
+def _rms_fwd_pallas(x, weight, eps, interpret):
     shape = x.shape
     d = shape[-1]
     x2 = x.reshape(-1, d)
@@ -55,7 +53,37 @@ def rms_norm(x, weight, eps: float = 1e-5, interpret: bool = False):
     return out.reshape(shape)
 
 
-def layer_norm(x, weight, bias, eps: float = 1e-5, interpret: bool = False):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms(x, weight, eps, interpret):
+    return _rms_fwd_pallas(x, weight, eps, interpret)
+
+
+def _rms_vjp_fwd(x, weight, eps, interpret):
+    return _rms_fwd_pallas(x, weight, eps, interpret), (x, weight)
+
+
+def _rms_vjp_bwd(eps, interpret, res, g):
+    # recompute stats from saved x (cheap vs HBM traffic of saving them)
+    x, weight = res
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    w32 = weight.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    gu = g32 * w32
+    s = jnp.mean(gu * x32, axis=-1, keepdims=True)
+    dx = r * gu - (r**3) * x32 * s
+    dw = jnp.sum((g32 * x32 * r).reshape(-1, x.shape[-1]), axis=0)
+    return dx.astype(x.dtype), dw.astype(weight.dtype)
+
+
+_rms.defvjp(_rms_vjp_fwd, _rms_vjp_bwd)
+
+
+def rms_norm(x, weight, eps: float = 1e-5, interpret: bool = False):
+    return _rms(x, weight, eps, interpret)
+
+
+def _ln_fwd_pallas(x, weight, bias, eps, interpret):
     shape = x.shape
     d = shape[-1]
     x2 = x.reshape(-1, d)
@@ -70,6 +98,39 @@ def layer_norm(x, weight, bias, eps: float = 1e-5, interpret: bool = False):
         interpret=interpret,
     )(x2, weight, bias)
     return out.reshape(shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _ln(x, weight, bias, eps, interpret):
+    return _ln_fwd_pallas(x, weight, bias, eps, interpret)
+
+
+def _ln_vjp_fwd(x, weight, bias, eps, interpret):
+    return _ln_fwd_pallas(x, weight, bias, eps, interpret), (x, weight)
+
+
+def _ln_vjp_bwd(eps, interpret, res, g):
+    x, weight = res
+    d = x.shape[-1]
+    x32 = x.astype(jnp.float32)
+    g32 = g.astype(jnp.float32)
+    w32 = weight.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(var + eps)
+    xhat = (x32 - mean) * rstd
+    gx = g32 * w32
+    dx = rstd * (gx - jnp.mean(gx, axis=-1, keepdims=True) - xhat * jnp.mean(gx * xhat, axis=-1, keepdims=True))
+    dw = jnp.sum((g32 * xhat).reshape(-1, d), axis=0)
+    db = jnp.sum(g32.reshape(-1, d), axis=0)
+    return dx.astype(x.dtype), dw.astype(weight.dtype), db.astype(weight.dtype)
+
+
+_ln.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5, interpret: bool = False):
+    return _ln(x, weight, bias, eps, interpret)
 
 
 REGISTRY.register("rms_norm", "pallas", rms_norm, is_available=pallas_available, priority=10)

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import REGISTRY, pallas_available
+from ._utils import block_that_divides
 
 
 def _quant_kernel(x_ref, q_ref, s_ref, *, bits):
@@ -35,10 +36,7 @@ def _dequant_kernel(q_ref, s_ref, o_ref):
 
 
 def _rows_block(n_rows: int, want: int = 512) -> int:
-    b = min(n_rows, want)
-    while n_rows % b:
-        b //= 2
-    return max(b, 1)
+    return block_that_divides(n_rows, want)
 
 
 def quantize_groupwise(x, group_size: int = 128, bits: int = 8, interpret: bool = False):

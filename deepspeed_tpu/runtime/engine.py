@@ -467,6 +467,20 @@ class DeepSpeedEngine:
                 client_state = self.checkpoint_engine.load(cs_path)
         return d, client_state
 
+    def save_universal_checkpoint(self, save_dir: str, tag=None):
+        """Write the degree-independent universal layout directly
+        (reference needs offline ``ds_to_universal.py`` for this)."""
+        from ..checkpoint.universal import save_universal_checkpoint
+
+        return save_universal_checkpoint(self, save_dir, tag)
+
+    def load_universal_checkpoint(self, load_dir: str, tag=None, load_optimizer_states: bool = True):
+        """Resume from a universal checkpoint at ANY mesh/zero-stage
+        (reference ``universal_checkpoint.py:22``)."""
+        from ..checkpoint.universal import load_universal_checkpoint
+
+        return load_universal_checkpoint(self, load_dir, tag, load_optimizer_states=load_optimizer_states)
+
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None, training_data=None, lr_scheduler=None,
                mesh=None, mpu=None, dist_init_required=None, collate_fn=None, config=None, **kwargs):

@@ -141,3 +141,63 @@ def test_registry_prefers_pallas_on_tpu_only():
     assert REGISTRY.selected("attention") == "xla"  # CPU test env
     report = REGISTRY.report()
     assert "attention" in report and "fused_adam" in report
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_cross_attention_sq_ne_sk(causal):
+    """Sq != Sk: queries align to the END of the kv sequence (chunked
+    prefill / suffix decode), matching attention_xla's offset convention."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, 32, 2, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 128, 2, 16).astype(np.float32))
+    ref = attention_xla(q, k, v, causal=causal)
+    out = flash_attention(q, k, v, causal=causal, interpret=True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out), atol=2e-5)
+
+
+def test_flash_cross_attention_bwd_sq_ne_sk():
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 32, 2, 16).astype(np.float32))
+    k = jnp.asarray(rng.randn(1, 64, 2, 16).astype(np.float32))
+    v = jnp.asarray(rng.randn(1, 64, 2, 16).astype(np.float32))
+    gr = jax.grad(lambda *a: jnp.sum(attention_xla(*a, causal=True)**2), argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(lambda *a: jnp.sum(flash_attention(*a, causal=True, interpret=True)**2), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-3, rtol=1e-3)
+
+
+def test_fused_adam_traced_step_under_jit():
+    """step may be a traced array: one compile serves every step."""
+    rng = np.random.RandomState(5)
+    p = jnp.asarray(rng.randn(300).astype(np.float32))
+    g = jnp.asarray(rng.randn(300).astype(np.float32))
+    m = jnp.zeros(300, jnp.float32)
+    v = jnp.zeros(300, jnp.float32)
+
+    @jax.jit
+    def step_fn(p, g, m, v, step):
+        return fused_adam_flat(p, g, m, v, 1e-3, step, block=256, interpret=True)
+
+    p1, m1, v1 = step_fn(p, g, m, v, jnp.asarray(1, jnp.int32))
+    ref = adam_xla(p, g, m, v, 1e-3, 1)
+    for a, b in zip((p1, m1, v1), ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_pallas_norm_grads_match_xla():
+    """jax.grad must flow through the priority-10 pallas norms."""
+    rng = np.random.RandomState(6)
+    x = jnp.asarray(rng.randn(4, 32, 64).astype(np.float32))
+    w = jnp.asarray(rng.randn(64).astype(np.float32))
+    b = jnp.asarray(rng.randn(64).astype(np.float32))
+
+    gr = jax.grad(lambda x, w: jnp.sum(rms_norm_xla(x, w)**2), argnums=(0, 1))(x, w)
+    gp = jax.grad(lambda x, w: jnp.sum(rms_norm(x, w, interpret=True)**2), argnums=(0, 1))(x, w)
+    for a, b_ in zip(gr, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4, rtol=2e-4)
+
+    gr = jax.grad(lambda x, w, b: jnp.sum(layer_norm_xla(x, w, b)**2), argnums=(0, 1, 2))(x, w, b)
+    gp = jax.grad(lambda x, w, b: jnp.sum(layer_norm(x, w, b, interpret=True)**2), argnums=(0, 1, 2))(x, w, b)
+    for a, b_ in zip(gr, gp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4, rtol=2e-4)
