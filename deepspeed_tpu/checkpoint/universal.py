@@ -38,9 +38,8 @@ ZERO_DIR = "zero"
 FP32 = "fp32.npy"
 MOMENT_FILES = ("exp_avg.npy", "exp_avg_sq.npy")  # reference naming (ds_to_universal.py:131)
 
-MODEL_STATES_FILENAME = "model_states.msgpack"
-OPTIM_STATES_FILENAME = "optim_states.msgpack"
-LATEST_FILENAME = "latest"
+# single source of truth for the native layout lives with the writer
+from ..runtime.engine import LATEST_FILENAME, MODEL_STATES_FILENAME, OPTIM_STATES_FILENAME  # noqa: E402
 
 
 def _param_file_name(name: str) -> str:
@@ -59,9 +58,9 @@ def _resolve_tag(ckpt_dir: str, tag: Optional[str]) -> str:
 
 
 def _load_native(ckpt_dir: str, tag: str) -> Tuple[Any, Optional[Dict]]:
-    from ..runtime.checkpoint_engine import MsgpackCheckpointEngine
+    from ..runtime.checkpoint_engine import create_checkpoint_engine
 
-    eng = MsgpackCheckpointEngine()
+    eng = create_checkpoint_engine()
     d = os.path.join(ckpt_dir, tag)
     params_sd = eng.load(os.path.join(d, MODEL_STATES_FILENAME))
     optim_path = os.path.join(d, OPTIM_STATES_FILENAME)
@@ -211,16 +210,18 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     params_host = from_state_dict(template_host, unflatten_named(params_flat))
     engine.params = jax.device_put(params_host, engine.param_shardings)
 
-    if load_optimizer_states and meta.get("n_moment_trees", 0) >= 0:
+    if load_optimizer_states:
         opt_host = jax.device_get(engine.opt_state)
         opt_sd = to_state_dict(opt_host)
         sig = leaf_signature(template_host)
         paths = find_param_shaped_subtrees(opt_sd, sig)
         for i, p in enumerate(paths[:meta.get("n_moment_trees", 0)]):
             mom_flat = _read_flat(zdir, _moment_file(i), list(tmpl_flat.keys()))
-            if len(mom_flat) == len(tmpl_flat):
-                tmpl_sub = get_subtree(opt_sd, p)
-                set_subtree(opt_sd, p, from_state_dict(tmpl_sub, unflatten_named(mom_flat)))
+            if len(mom_flat) != len(tmpl_flat):
+                lost = [n for n in tmpl_flat if n not in mom_flat]
+                raise KeyError(f"universal checkpoint at {root} missing {_moment_file(i)} for params: {lost[:5]}...")
+            tmpl_sub = get_subtree(opt_sd, p)
+            set_subtree(opt_sd, p, from_state_dict(tmpl_sub, unflatten_named(mom_flat)))
         scalar_path = os.path.join(root, SCALAR_STATE)
         scalar_state: Dict[str, Any] = {}
         if os.path.exists(scalar_path):

@@ -1,0 +1,25 @@
+"""Inference v2 — FastGen-equivalent ragged serving stack.
+
+Parity: reference ``deepspeed/inference/v2/`` (``InferenceEngineV2``
+``engine_v2.py:30``, ``DSStateManager`` ``ragged/ragged_manager.py:19``,
+``BlockedAllocator`` ``ragged/blocked_allocator.py``, continuous-batching
+scheduling ``engine_v2.py:184``). TPU-native re-design: paged KV cache as
+block-table-indexed page arrays consumed by a Pallas decode kernel, with
+prefill/decode split into two jitted bucketed programs instead of one
+CUDA ragged kernel suite.
+"""
+
+from .ragged import BlockedAllocator, DSSequenceDescriptor, DSStateManager, RaggedBatchConfig
+from .scheduler import RaggedRequest, RaggedBatchScheduler
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+
+__all__ = [
+    "BlockedAllocator",
+    "DSSequenceDescriptor",
+    "DSStateManager",
+    "RaggedBatchConfig",
+    "RaggedRequest",
+    "RaggedBatchScheduler",
+    "InferenceEngineV2",
+    "RaggedInferenceEngineConfig",
+]

@@ -1,0 +1,258 @@
+"""InferenceEngineV2 — continuous-batching ragged inference.
+
+Parity: reference ``inference/v2/engine_v2.py`` (``InferenceEngineV2``:
+``put(uids, tokens)`` ragged forward :107, scheduling feasibility
+``query``/``can_put`` :184, ``flush`` :171) + ``DSStateManager`` and
+paged-KV plumbing. TPU re-design:
+
+- the KV cache is a stacked page pool ``(layers, blocks, block_size,
+  KVH, D)`` pair, functionally updated under jit with buffer donation
+  (no in-place CUDA workspace);
+- one jitted *decode* program (Pallas paged attention, batch bucketed to
+  powers of two) and one jitted *prefill* program (chunk of one sequence,
+  length bucketed) replace the CUDA ragged kernel suite;
+- block 0 of the pool is reserved as a garbage page: padded tokens in a
+  bucket write their KV there, so padding never corrupts live sequences.
+"""
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...models.transformer import TransformerConfig
+from ...utils.logging import log_dist, logger
+from .model_runner import make_step_fns
+from .ragged.manager import DSStateManager, RaggedBatchConfig
+from .scheduler import RaggedBatchScheduler, RaggedRequest
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@dataclass
+class RaggedInferenceEngineConfig:
+    """Parity: reference ``inference/v2/config_v2.py`` (RaggedInferenceEngineConfig)."""
+    state_manager: RaggedBatchConfig = field(default_factory=RaggedBatchConfig)
+    tensor_parallel: int = 1
+    dtype: str = "bfloat16"
+    interpret_kernels: Optional[bool] = None  # Pallas interpret mode; default: on unless running on real TPU
+
+    @classmethod
+    def from_dict(cls, d: Dict) -> "RaggedInferenceEngineConfig":
+        d = dict(d or {})
+        sm = d.pop("state_manager", {})
+        if isinstance(sm, dict):
+            sm = RaggedBatchConfig(**sm)
+        return cls(state_manager=sm, **d)
+
+
+class InferenceEngineV2:
+
+    def __init__(self, model, params, config: Optional[RaggedInferenceEngineConfig] = None):
+        """``model`` is a ``CausalLM`` (or anything exposing ``.cfg``)."""
+        if config is None:
+            config = RaggedInferenceEngineConfig()
+        elif isinstance(config, dict):
+            config = RaggedInferenceEngineConfig.from_dict(config)
+        self._config = config
+        self.model = model
+        cfg: TransformerConfig = model.cfg
+        self.cfg = cfg
+        self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else jnp.float32
+
+        smc = config.state_manager
+        n_blocks = smc.num_kv_blocks
+        if n_blocks is None:
+            bytes_per_block = (2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * cfg.head_dim *
+                               jnp.dtype(self.dtype).itemsize)
+            n_blocks = max(8, int(smc.memory_gb * (1 << 30) // bytes_per_block))
+        self.state = DSStateManager(smc, n_blocks)
+        self.scheduler = RaggedBatchScheduler(self.state, max_batch_tokens=smc.max_ragged_batch_size,
+                                              max_sequences=smc.max_ragged_sequence_count)
+
+        # garbage page for padded-token KV writes (allocator's first pop is 0)
+        self._garbage_block = self.state._allocator.allocate(1)[0]
+        assert self._garbage_block == 0
+
+        L, bs = cfg.n_layers, smc.kv_block_size
+        self.k_pages = jnp.zeros((L, n_blocks, bs, cfg.kv_heads, cfg.head_dim), self.dtype)
+        self.v_pages = jnp.zeros_like(self.k_pages)
+        self._max_blocks_per_seq = -(-smc.max_context // bs)
+
+        cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        run_cfg = dataclasses.replace(cfg, dtype=self.dtype)
+        self.params = jax.tree_util.tree_map(cast, params)
+        interpret = config.interpret_kernels
+        if interpret is None:
+            from ...ops.registry import pallas_available
+            interpret = not pallas_available()
+        self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret)
+        log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
+                 f"({n_blocks * bs} cached tokens), dtype={config.dtype}", ranks=[0])
+
+    # ---------------------------------------------------------- feasibility
+    def query(self, uid: int, max_request_length: int) -> Tuple[int, int]:
+        """(max new tokens schedulable, free KV blocks). Reference engine_v2.py:184."""
+        seq = self.state.get_sequence(uid)
+        free_tokens = self.state.free_blocks * self.state.block_size
+        if seq is not None:
+            free_tokens += seq.max_context - seq.seen_tokens
+        return min(max_request_length, free_tokens), self.state.free_blocks
+
+    def can_put(self, uid: int, tokens: Sequence[int]) -> bool:
+        seq = self.state.get_sequence(uid)
+        bs = self.state.block_size
+        if seq is None:
+            need = -(-len(tokens) // bs)
+        else:
+            need = seq.blocks_needed(len(tokens))
+        return self.state.can_allocate(need)
+
+    # ---------------------------------------------------------- core step
+    def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]]) -> np.ndarray:
+        """Run one engine step over a ragged batch; returns next-token logits (B, V).
+
+        Sequences with multiple tokens run as (chunked) prefill; known
+        sequences with a single token join one batched paged-decode call.
+        """
+        if len(batch_uids) != len(batch_tokens):
+            raise ValueError("uids and token lists must align")
+        logits_by_idx: Dict[int, np.ndarray] = {}
+
+        decode_idx: List[int] = []
+        for i, (uid, toks) in enumerate(zip(batch_uids, batch_tokens)):
+            seq = self.state.get_sequence(uid)
+            if seq is not None and len(toks) == 1:
+                decode_idx.append(i)
+            else:
+                logits_by_idx[i] = self._run_prefill(uid, list(toks))
+
+        if decode_idx:
+            uids = [batch_uids[i] for i in decode_idx]
+            toks = [int(batch_tokens[i][0]) for i in decode_idx]
+            out = self._run_decode(uids, toks)
+            for i, row in zip(decode_idx, out):
+                logits_by_idx[i] = row
+        return np.stack([logits_by_idx[i] for i in range(len(batch_uids))])
+
+    def flush(self, uids: Sequence[int]) -> None:
+        for uid in uids:
+            self.state.flush_sequence(uid)
+
+    # ---------------------------------------------------------- internals
+    def _seq_block_row(self, seq) -> np.ndarray:
+        row = np.full((self._max_blocks_per_seq,), self._garbage_block, np.int32)
+        row[:len(seq.blocks)] = seq.blocks
+        return row
+
+    def _garbage_slots(self, n: int) -> np.ndarray:
+        # round-robin within the garbage page so padded writes stay cheap
+        return (self._garbage_block * self.state.block_size + np.arange(n) % self.state.block_size).astype(np.int32)
+
+    def _run_prefill(self, uid: int, tokens: List[int]) -> np.ndarray:
+        """Prefill one sequence chunk (possibly with prior context)."""
+        seq = self.state.get_or_create_sequence(uid)
+        self.state.allocate_for(seq, len(tokens))
+        seq.pre_forward(len(tokens))
+        bs = self.state.block_size
+        start, n = seq.seen_tokens, len(tokens)
+        S = max(16, _next_pow2(n))
+
+        ids = np.zeros((1, S), np.int32)
+        ids[0, :n] = tokens
+        positions = np.zeros((1, S), np.int32)
+        positions[0, :n] = np.arange(start, start + n)
+        slots = self._garbage_slots(S)
+        for t in range(n):
+            pos = start + t
+            slots[t] = seq.blocks[pos // bs] * bs + pos % bs
+        ctx = np.array([start + n], np.int32)
+        bt = self._seq_block_row(seq)[None]
+        last = np.array([n - 1], np.int32)
+
+        logits, self.k_pages, self.v_pages = self._prefill_fn(self.params, jnp.asarray(ids), jnp.asarray(positions),
+                                                              self.k_pages, self.v_pages, jnp.asarray(bt),
+                                                              jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last))
+        seq.post_forward()
+        return np.asarray(logits[0])
+
+    def _run_decode(self, uids: List[int], tokens: List[int]) -> np.ndarray:
+        n = len(uids)
+        B = _next_pow2(n)
+        bs = self.state.block_size
+        ids = np.zeros((B, 1), np.int32)
+        positions = np.zeros((B, 1), np.int32)
+        ctx = np.zeros((B,), np.int32)
+        bt = np.full((B, self._max_blocks_per_seq), self._garbage_block, np.int32)
+        slots = self._garbage_slots(B)
+        seqs = []
+        for j, (uid, tok) in enumerate(zip(uids, tokens)):
+            seq = self.state.get_sequence(uid)
+            self.state.allocate_for(seq, 1)
+            seq.pre_forward(1)
+            pos = seq.seen_tokens
+            ids[j, 0] = tok
+            positions[j, 0] = pos
+            ctx[j] = pos + 1
+            bt[j] = self._seq_block_row(seq)
+            slots[j] = seq.blocks[pos // bs] * bs + pos % bs
+            seqs.append(seq)
+        last = np.zeros((B,), np.int32)
+
+        logits, self.k_pages, self.v_pages = self._decode_fn(self.params, jnp.asarray(ids), jnp.asarray(positions),
+                                                             self.k_pages, self.v_pages, jnp.asarray(bt),
+                                                             jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last))
+        for seq in seqs:
+            seq.post_forward()
+        return np.asarray(logits[:n])
+
+    # ---------------------------------------------------------- serving loop
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Greedy continuous-batching generation over a set of prompts.
+
+        Drives the scheduler the way a serving frontend (MII) drives the
+        reference engine: admit prefills as KV blocks free up, batch all
+        live decodes each step.
+        """
+        reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
+        pending = list(reqs.values())
+        decode_ready: Dict[int, int] = {}  # uid -> next token to feed
+        results: Dict[int, List[int]] = {i: [] for i in reqs}
+
+        while pending or decode_ready:
+            step = self.scheduler.schedule([r for r in pending if r.remaining_prefill], list(decode_ready))
+            if step.empty:
+                raise RuntimeError("scheduler deadlock: no work schedulable (KV pool too small?)")
+            uids, toks = [], []
+            for uid in step.decode_uids:
+                uids.append(uid)
+                toks.append([decode_ready.pop(uid)])
+            for pf in step.prefills:
+                req = reqs[pf.uid]
+                uids.append(pf.uid)
+                toks.append(pf.tokens)
+                req.tokens = req.tokens[len(pf.tokens):]
+            logits = self.put(uids, toks)
+            nxt = np.argmax(logits, axis=-1)
+            for uid, tok in zip(uids, nxt):
+                req = reqs[uid]
+                if req.remaining_prefill:
+                    continue  # mid-prefill chunk: logits not a sampled token yet
+                results[uid].append(int(tok))
+                done = len(results[uid]) >= req.max_new_tokens or (eos_token_id is not None and tok == eos_token_id)
+                if done:
+                    req.done = True
+                    self.flush([uid])
+                else:
+                    decode_ready[uid] = int(tok)
+            pending = [r for r in pending if not r.done and r.remaining_prefill]
+        return [results[i] for i in range(len(prompts))]

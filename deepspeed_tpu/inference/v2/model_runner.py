@@ -1,0 +1,127 @@
+"""Functional ragged forward over a ``CausalLM`` parameter tree.
+
+Parity: reference ``inference/v2/model_implementations/`` builds its own
+inference-only model graph (LayerContainer + policy) instead of running
+the training module — same stance here: the runner consumes the flax
+param pytree directly (``models/transformer.py`` layout) and executes a
+paged-KV forward built from jnp ops + the Pallas paged-attention kernel.
+Two jitted programs per model:
+
+- ``prefill``: (1, S) tokens of one sequence chunk; standard causal
+  attention against the gathered paged context (supports chunked prefill
+  with history), KV written to pages via slot mapping.
+- ``decode``: (B, 1) tokens, one per sequence; Pallas paged decode.
+
+MoE blocks are not yet supported in the v2 runner (the training/MoE path
+covers them); raise early instead of silently miscomputing.
+"""
+
+import functools
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import TransformerConfig, rope_frequencies
+from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_ref, update_kv_pages)
+
+
+def _norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float, dtype) -> jnp.ndarray:
+    x32 = x.astype(jnp.float32)
+    if "bias" in p:  # layernorm
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + eps)
+        return (y * p["scale"] + p["bias"]).astype(dtype)
+    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
+    return (y * p["scale"]).astype(dtype)
+
+
+def _proj(x: jnp.ndarray, p: Dict[str, jnp.ndarray], spec: str, dtype) -> jnp.ndarray:
+    y = jnp.einsum(spec, x, p["kernel"].astype(dtype))
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+def _apply_rope(x, cos, sin, positions):
+    c = cos[positions][:, :, None, :]
+    s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _mlp(x: jnp.ndarray, p: Dict[str, Any], activation: str, dtype) -> jnp.ndarray:
+    if activation == "swiglu":
+        h = jax.nn.silu(_proj(x, p["gate_proj"], "bsd,df->bsf", dtype)) * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
+    else:
+        h = jax.nn.gelu(_proj(x, p["up_proj"], "bsd,df->bsf", dtype))
+    return _proj(h, p["down_proj"], "bsf,fd->bsd", dtype)
+
+
+def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
+                   k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray, ctx_lens: jnp.ndarray,
+                   slot_mapping: jnp.ndarray, last_token_idx: jnp.ndarray, *, decode: bool,
+                   interpret: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One engine step over the paged cache.
+
+    input_ids/positions: (B, S); k_pages/v_pages: (L, N, bs, KVH, D);
+    block_tables: (B, P); ctx_lens: (B,) context length *including* the
+    current tokens; slot_mapping: (B*S,) flat KV slots for the new tokens;
+    last_token_idx: (B,) index of the last real (non-pad) token per row.
+    Returns (last-real-token logits (B, V), k_pages, v_pages).
+    """
+    if cfg.moe_num_experts > 0:
+        raise NotImplementedError("MoE models are not yet supported by the v2 ragged runner")
+    B, S = input_ids.shape
+    H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    dtype = cfg.dtype
+
+    x = params["wte"][input_ids].astype(dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["wpe"][positions].astype(dtype)
+    cos = sin = None
+    if cfg.pos_emb == "rope":
+        cos, sin = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
+
+    norm_key = "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
+    for i in range(cfg.n_layers):
+        lp = params[f"layer_{i}"]
+        h = _norm(x, lp[f"{norm_key}_0"], cfg.norm_eps, dtype)
+        q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
+        k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
+        v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
+        if cfg.pos_emb == "rope":
+            q = _apply_rope(q, cos, sin, positions)
+            k = _apply_rope(k, cos, sin, positions)
+
+        kp, vp = update_kv_pages(k_pages[i], v_pages[i], k.reshape(B * S, KVH, D), v.reshape(B * S, KVH, D),
+                                 slot_mapping)
+        k_pages = k_pages.at[i].set(kp)
+        v_pages = v_pages.at[i].set(vp)
+
+        if decode:
+            attn = paged_attention_decode(q[:, 0], kp, vp, block_tables, ctx_lens, interpret=interpret)[:, None]
+        else:
+            attn = paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions)
+        x = x + _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
+        h2 = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
+        x = x + _mlp(h2, lp["mlp"], cfg.activation, dtype)
+
+    x = _norm(x, params[f"{norm_key}_0"], cfg.norm_eps, dtype)
+    last = x[jnp.arange(B), last_token_idx, :]
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(dtype))
+    else:
+        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"]["kernel"].astype(dtype))
+    return logits.astype(jnp.float32), k_pages, v_pages
+
+
+def make_step_fns(cfg: TransformerConfig, interpret: bool = False):
+    """Jitted (prefill_fn, decode_fn) with donated page buffers."""
+    prefill = jax.jit(functools.partial(ragged_forward, cfg, decode=False, interpret=interpret),
+                      donate_argnums=(3, 4), static_argnames=())
+    decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret),
+                     donate_argnums=(3, 4), static_argnames=())
+    return prefill, decode

@@ -1,0 +1,5 @@
+from .blocked_allocator import BlockedAllocator
+from .sequence_descriptor import DSSequenceDescriptor
+from .manager import DSStateManager, RaggedBatchConfig
+
+__all__ = ["BlockedAllocator", "DSSequenceDescriptor", "DSStateManager", "RaggedBatchConfig"]

@@ -1,0 +1,50 @@
+"""Paged-KV block allocator.
+
+Parity: reference ``inference/v2/ragged/blocked_allocator.py``
+(``BlockedAllocator``): a fixed pool of KV-cache blocks handed out to
+sequences and returned on free. The reference keeps the free list in a
+device tensor (it is consumed by CUDA kernels); on TPU the block table is
+assembled host-side per batch and shipped to the kernel as a scalar-
+prefetch operand, so a plain host free-list is the right structure.
+"""
+
+from typing import Iterable, List
+
+
+class BlockedAllocator:
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        # LIFO free list: recently-freed (still-warm) blocks are reused first.
+        self._free: List[int] = list(range(num_blocks - 1, -1, -1))
+        self._allocated = [False] * num_blocks
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def allocate(self, num_blocks: int) -> List[int]:
+        """Take ``num_blocks`` block ids; raises if the pool is exhausted."""
+        if num_blocks < 0:
+            raise ValueError(f"cannot allocate {num_blocks} blocks")
+        if num_blocks > len(self._free):
+            raise RuntimeError(f"out of KV blocks: want {num_blocks}, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(num_blocks)]
+        for b in out:
+            self._allocated[b] = True
+        return out
+
+    def free(self, blocks: Iterable[int]) -> None:
+        for b in blocks:
+            if not (0 <= b < self._num_blocks):
+                raise ValueError(f"block id {b} out of range")
+            if not self._allocated[b]:
+                raise ValueError(f"double free of block {b}")
+            self._allocated[b] = False
+            self._free.append(b)

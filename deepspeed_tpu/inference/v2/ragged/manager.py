@@ -1,0 +1,90 @@
+"""Ragged sequence/KV state manager.
+
+Parity: reference ``inference/v2/ragged/ragged_manager.py``
+(``DSStateManager``): owns the block allocator and the uid -> sequence
+descriptor table; hands out / reclaims KV blocks as sequences grow and
+retire. The device-side KV pages themselves live in the engine (stacked
+per-layer page arrays updated functionally under jit with donation).
+"""
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+from ....utils.logging import logger
+from .blocked_allocator import BlockedAllocator
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+@dataclass
+class RaggedBatchConfig:
+    """Parity: reference ``inference/v2/ragged/manager_configs.py``."""
+    max_tracked_sequences: int = 2048
+    max_ragged_batch_size: int = 768  # token budget per engine step
+    max_ragged_sequence_count: int = 512  # sequence budget per engine step
+    max_context: int = 8192  # per-sequence KV capacity cap
+    kv_block_size: int = 128
+    num_kv_blocks: Optional[int] = None  # None => engine sizes from memory_gb
+    memory_gb: float = 4.0  # KV pool budget when num_kv_blocks is None
+
+
+class DSStateManager:
+
+    def __init__(self, config: RaggedBatchConfig, num_kv_blocks: int):
+        self._config = config
+        self._allocator = BlockedAllocator(num_kv_blocks)
+        self._seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    @property
+    def block_size(self) -> int:
+        return self._config.kv_block_size
+
+    @property
+    def free_blocks(self) -> int:
+        return self._allocator.free_blocks
+
+    @property
+    def total_blocks(self) -> int:
+        return self._allocator.total_blocks
+
+    @property
+    def n_tracked_sequences(self) -> int:
+        return len(self._seqs)
+
+    def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
+        return self._seqs.get(uid)
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        seq = self._seqs.get(uid)
+        if seq is not None:
+            return seq
+        if len(self._seqs) >= self._config.max_tracked_sequences:
+            raise RuntimeError(f"tracking {len(self._seqs)} sequences; "
+                               f"max_tracked_sequences={self._config.max_tracked_sequences}")
+        seq = DSSequenceDescriptor(uid=uid, block_size=self.block_size)
+        self._seqs[uid] = seq
+        return seq
+
+    def allocate_for(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
+        """Grow ``seq``'s block list to cover ``new_tokens`` more KV slots."""
+        total = seq.seen_tokens + seq.in_flight_tokens + new_tokens
+        if total > self._config.max_context:
+            raise RuntimeError(f"sequence {seq.uid}: {total} tokens exceeds max_context {self._config.max_context}")
+        need = seq.blocks_needed(new_tokens)
+        if need:
+            seq.extend_blocks(self._allocator.allocate(need))
+
+    def can_allocate(self, num_blocks: int) -> bool:
+        return num_blocks <= self._allocator.free_blocks
+
+    def flush_sequence(self, uid: int) -> None:
+        """Retire a sequence and return its blocks to the pool."""
+        seq = self._seqs.pop(uid, None)
+        if seq is None:
+            logger.debug(f"flush of unknown sequence {uid}")
+            return
+        if seq.blocks:
+            self._allocator.free(seq.blocks)
+
+    def flush_all(self) -> None:
+        for uid in list(self._seqs):
+            self.flush_sequence(uid)

@@ -1,0 +1,42 @@
+"""Per-sequence KV bookkeeping.
+
+Parity: reference ``inference/v2/ragged/sequence_descriptor.py``
+(``DSSequenceDescriptor``): tracks a live sequence's seen tokens, its KV
+block ids, and in-flight tokens for the current engine step.
+"""
+
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class DSSequenceDescriptor:
+    uid: int
+    block_size: int
+    seen_tokens: int = 0  # tokens whose KV already lives in the cache
+    blocks: List[int] = field(default_factory=list)
+    in_flight_tokens: int = 0  # tokens in the currently-running forward
+
+    @property
+    def cur_allocated_blocks(self) -> int:
+        return len(self.blocks)
+
+    @property
+    def max_context(self) -> int:
+        return len(self.blocks) * self.block_size
+
+    def blocks_needed(self, new_tokens: int) -> int:
+        """Extra blocks required to hold ``new_tokens`` more KV entries."""
+        total = self.seen_tokens + self.in_flight_tokens + new_tokens
+        need = -(-total // self.block_size)  # ceil
+        return max(0, need - len(self.blocks))
+
+    def extend_blocks(self, new_blocks: List[int]) -> None:
+        self.blocks.extend(new_blocks)
+
+    def pre_forward(self, num_tokens: int) -> None:
+        self.in_flight_tokens = num_tokens
+
+    def post_forward(self) -> None:
+        self.seen_tokens += self.in_flight_tokens
+        self.in_flight_tokens = 0

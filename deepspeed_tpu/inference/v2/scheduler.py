@@ -1,0 +1,99 @@
+"""Continuous-batching scheduler.
+
+Parity: reference ``inference/v2/engine_v2.py:184`` exposes scheduling
+*feasibility* (``query``/``can_put``) and leaves policy to MII's
+``RaggedRequestBatch``; here the policy lives in-tree: a FIFO queue with
+chunked prefill, a per-step token budget, and decode-priority admission
+(decodes are one token and keep latency low; prefills fill the rest of
+the budget), in the style of the FastGen "Dynamic SplitFuse" scheduler
+(reference blog ``blogs/deepspeed-fastgen``).
+"""
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+from .ragged.manager import DSStateManager
+
+
+@dataclass
+class RaggedRequest:
+    uid: int
+    tokens: List[int]  # prompt tokens not yet prefilled
+    max_new_tokens: int = 64
+    generated: List[int] = field(default_factory=list)
+    done: bool = False
+
+    @property
+    def remaining_prefill(self) -> int:
+        return len(self.tokens)
+
+
+@dataclass
+class ScheduledPrefill:
+    uid: int
+    tokens: List[int]
+    start_pos: int
+
+
+@dataclass
+class ScheduledStep:
+    prefills: List[ScheduledPrefill]
+    decode_uids: List[int]
+
+    @property
+    def empty(self) -> bool:
+        return not self.prefills and not self.decode_uids
+
+
+class RaggedBatchScheduler:
+
+    def __init__(self, state: DSStateManager, max_batch_tokens: int = 768, max_sequences: int = 512,
+                 prefill_chunk: int = 512):
+        self._state = state
+        self.max_batch_tokens = max_batch_tokens
+        self.max_sequences = max_sequences
+        self.prefill_chunk = prefill_chunk
+
+    def schedule(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> ScheduledStep:
+        """Pick the work for one engine step.
+
+        Decodes are admitted first (1 token each); remaining token budget
+        is given to FIFO prefills, chunked to ``prefill_chunk``. A request
+        is only admitted if its KV blocks fit the free pool.
+        """
+        bs = self._state.block_size
+        budget = self.max_batch_tokens
+        seqs = 0
+        sched_decodes: List[int] = []
+        free = self._state.free_blocks
+
+        for uid in decode_uids:
+            seq = self._state.get_sequence(uid)
+            if seq is None or budget < 1 or seqs >= self.max_sequences:
+                continue
+            need = seq.blocks_needed(1)
+            if need > free:
+                continue  # back-pressure: leave it for the next step
+            free -= need
+            budget -= 1
+            seqs += 1
+            sched_decodes.append(uid)
+
+        prefills: List[ScheduledPrefill] = []
+        for req in pending_prefills:
+            if budget <= 0 or seqs >= self.max_sequences:
+                break
+            take = min(req.remaining_prefill, self.prefill_chunk, budget)
+            if take <= 0:
+                continue
+            seq = self._state.get_or_create_sequence(req.uid)
+            total = seq.seen_tokens + take
+            need = -(-total // bs) - len(seq.blocks)
+            if need > free:
+                break  # FIFO: do not let later requests starve this one
+            free -= max(0, need)
+            budget -= take
+            seqs += 1
+            prefills.append(ScheduledPrefill(uid=req.uid, tokens=req.tokens[:take], start_pos=seq.seen_tokens))
+
+        return ScheduledStep(prefills=prefills, decode_uids=sched_decodes)

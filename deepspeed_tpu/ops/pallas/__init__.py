@@ -7,7 +7,8 @@ from . import flash_attention, fused_adam, norms, quantization  # noqa: F401
 from .flash_attention import flash_attention as flash_attention_fn
 from .fused_adam import fused_adam_flat
 from .norms import layer_norm, rms_norm
+from .paged_attention import paged_attention_decode, paged_attention_ref, update_kv_pages
 from .quantization import cast_fp8, dequantize_groupwise, quantize_groupwise
 
 __all__ = ["flash_attention_fn", "fused_adam_flat", "rms_norm", "layer_norm", "quantize_groupwise",
-           "dequantize_groupwise", "cast_fp8"]
+           "dequantize_groupwise", "cast_fp8", "paged_attention_decode", "paged_attention_ref", "update_kv_pages"]

@@ -106,11 +106,11 @@ def _ln(x, weight, bias, eps, interpret):
 
 
 def _ln_vjp_fwd(x, weight, bias, eps, interpret):
-    return _ln_fwd_pallas(x, weight, bias, eps, interpret), (x, weight)
+    return _ln_fwd_pallas(x, weight, bias, eps, interpret), (x, weight, bias)
 
 
 def _ln_vjp_bwd(eps, interpret, res, g):
-    x, weight = res
+    x, weight, bias = res
     d = x.shape[-1]
     x32 = x.astype(jnp.float32)
     g32 = g.astype(jnp.float32)
@@ -123,7 +123,7 @@ def _ln_vjp_bwd(eps, interpret, res, g):
     dx = rstd * (gx - jnp.mean(gx, axis=-1, keepdims=True) - xhat * jnp.mean(gx * xhat, axis=-1, keepdims=True))
     dw = jnp.sum((g32 * xhat).reshape(-1, d), axis=0)
     db = jnp.sum(g32.reshape(-1, d), axis=0)
-    return dx.astype(x.dtype), dw.astype(weight.dtype), db.astype(weight.dtype)
+    return dx.astype(x.dtype), dw.astype(weight.dtype), db.astype(bias.dtype)
 
 
 _ln.defvjp(_ln_vjp_fwd, _ln_vjp_bwd)

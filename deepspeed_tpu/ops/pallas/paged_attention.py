@@ -1,0 +1,169 @@
+"""Paged (block-table) KV-cache attention for ragged serving.
+
+Parity: reference ``inference/v2/kernels/ragged_ops/`` — the FastGen
+CUDA suite (blocked flash attention over a paged KV cache, KV copy with
+rotary, ``linear_blocked_kv_rotary/``). TPU re-design:
+
+- KV pages are a flat pool ``(num_blocks, block_size, KVH, D)`` per layer;
+  a per-batch ``block_table`` maps (sequence, page-slot) -> pool block.
+- Decode (one query token per sequence) runs a Pallas kernel with the
+  block table as a scalar-prefetch operand: the grid walks (batch, page)
+  and the page index_map dereferences the table, so only live pages are
+  streamed from HBM — the paged analogue of flash attention's online
+  softmax.
+- Prefill uses the gather-based XLA path (compute-bound; one gather of
+  the context is cheap relative to the matmuls and XLA fuses the mask).
+
+New KV entries are written with ``update_kv_pages`` via a flat
+"slot mapping" (token -> block*block_size+offset), computed host-side by
+the engine.
+"""
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-only submodule; absent on CPU-only jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------
+# KV page update
+# ------------------------------------------------------------------
+def update_kv_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                    slot_mapping: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Scatter new KV entries into the page pool.
+
+    k_pages/v_pages: (N, bs, KVH, D); k_new/v_new: (T, KVH, D);
+    slot_mapping: (T,) int32 flat slot = block_id * bs + offset.
+    """
+    n, bs, kvh, d = k_pages.shape
+    flat_k = k_pages.reshape(n * bs, kvh, d)
+    flat_v = v_pages.reshape(n * bs, kvh, d)
+    flat_k = flat_k.at[slot_mapping].set(k_new.astype(flat_k.dtype))
+    flat_v = flat_v.at[slot_mapping].set(v_new.astype(flat_v.dtype))
+    return flat_k.reshape(n, bs, kvh, d), flat_v.reshape(n, bs, kvh, d)
+
+
+# ------------------------------------------------------------------
+# Gather-based reference path (prefill + CPU fallback)
+# ------------------------------------------------------------------
+def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
+                        ctx_lens: jnp.ndarray, q_positions: jnp.ndarray, scale: Optional[float] = None) -> jnp.ndarray:
+    """Causal attention of q against paged context.
+
+    q: (B, S, H, D); block_tables: (B, P); ctx_lens: (B,) total context
+    (incl. the S new tokens); q_positions: (B, S) absolute positions.
+    Returns (B, S, H, D).
+    """
+    B, S, H, D = q.shape
+    _, bs, KVH, _ = k_pages.shape
+    P = block_tables.shape[1]
+    G = H // KVH
+    scale = scale if scale is not None else D**-0.5
+
+    k = k_pages[block_tables].reshape(B, P * bs, KVH, D)  # (B, L, KVH, D)
+    v = v_pages[block_tables].reshape(B, P * bs, KVH, D)
+    L = P * bs
+
+    qf = q.astype(jnp.float32).reshape(B, S, KVH, G, D) * scale
+    s = jnp.einsum("bskgd,blkd->bskgl", qf, k.astype(jnp.float32))
+    key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, None, None, :]
+    valid = (key_pos < ctx_lens[:, None, None, None, None]) & (key_pos <= q_positions[:, :, None, None, None])
+    s = jnp.where(valid, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bskgl,blkd->bskgd", p, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+# ------------------------------------------------------------------
+# Pallas decode kernel
+# ------------------------------------------------------------------
+def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, bs: int,
+                   kvh: int, g: int, d: int, pages: int, scale: float):
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = ctx_lens_ref[b]
+    start = p * bs
+
+    @pl.when(start < ctx)
+    def _compute():
+        q = q_ref[0].reshape(kvh, g, d).astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)  # (bs, kvh, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.einsum("kgd,tkd->kgt", q, k, preferred_element_type=jnp.float32)
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
+        s = jnp.where(pos < ctx, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        pij = jnp.exp(s - m_new[..., None])
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pij, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum("kgt,tkd->kgd", pij, v)
+        m_ref[...] = m_new
+
+    @pl.when(p == pages - 1)
+    def _finish():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l[..., None]).reshape(kvh * g, d).astype(o_ref.dtype)
+
+
+def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
+                           ctx_lens: jnp.ndarray, scale: Optional[float] = None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """One-token-per-sequence paged attention.
+
+    q: (B, H, D); k_pages/v_pages: (N, bs, KVH, D); block_tables: (B, P);
+    ctx_lens: (B,). Returns (B, H, D). Rows with ctx_len == 0 (padding)
+    produce unspecified output.
+    """
+    B, H, D = q.shape
+    N, bs, KVH, _ = k_pages.shape
+    P = block_tables.shape[1]
+    G = H // KVH
+    scale = scale if scale is not None else D**-0.5
+
+    if pltpu is None:  # pallas TPU submodule absent: gather path covers interpret mode too
+        return paged_attention_ref(q[:, None], k_pages, v_pages, block_tables, ctx_lens,
+                                   (ctx_lens - 1)[:, None], scale)[:, 0]
+
+    kernel = functools.partial(_decode_kernel, bs=bs, kvh=KVH, g=G, d=D, pages=P, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)),
+            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, G, D), jnp.float32),
+            pltpu.VMEM((KVH, G), jnp.float32),
+            pltpu.VMEM((KVH, G), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("parallel", "arbitrary")) if not interpret and
+        hasattr(pltpu, "TPUCompilerParams") else None,
+    )(block_tables, ctx_lens, q, k_pages, v_pages)

@@ -201,3 +201,33 @@ def test_pallas_norm_grads_match_xla():
     gp = jax.grad(lambda x, w, b: jnp.sum(layer_norm(x, w, b, interpret=True)**2), argnums=(0, 1, 2))(x, w, b)
     for a, b_ in zip(gr, gp):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=2e-4, rtol=2e-4)
+
+
+def test_paged_attention_decode_matches_ref():
+    """Pallas paged decode (block-table scalar prefetch) vs gather reference."""
+    from deepspeed_tpu.ops.pallas.paged_attention import (paged_attention_decode, paged_attention_ref,
+                                                          update_kv_pages)
+
+    rng = np.random.RandomState(11)
+    B, H, KVH, D, bs, P, N = 3, 4, 2, 16, 8, 4, 16
+    ctx = np.array([5, 17, 8], np.int32)
+    bt = np.zeros((B, P), np.int32)
+    k_pages = jnp.zeros((N, bs, KVH, D), jnp.float32)
+    v_pages = jnp.zeros_like(k_pages)
+    nxt, slots, ks, vs = 1, [], [], []
+    for b in range(B):
+        nb = -(-int(ctx[b]) // bs)
+        blocks = list(range(nxt, nxt + nb))
+        nxt += nb
+        bt[b, :nb] = blocks
+        for t in range(int(ctx[b])):
+            slots.append(blocks[t // bs] * bs + t % bs)
+            ks.append(rng.randn(KVH, D))
+            vs.append(rng.randn(KVH, D))
+    k_pages, v_pages = update_kv_pages(k_pages, v_pages, jnp.asarray(np.stack(ks), jnp.float32),
+                                       jnp.asarray(np.stack(vs), jnp.float32), jnp.asarray(slots, jnp.int32))
+    q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+    ctx_j, bt_j = jnp.asarray(ctx), jnp.asarray(bt)
+    out_ref = paged_attention_ref(q[:, None], k_pages, v_pages, bt_j, ctx_j, (ctx_j - 1)[:, None])[:, 0]
+    out_pal = paged_attention_decode(q, k_pages, v_pages, bt_j, ctx_j, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_pal), np.asarray(out_ref), atol=2e-6, rtol=2e-6)
