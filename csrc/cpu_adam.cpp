@@ -1,0 +1,79 @@
+// Host-side Adam/AdamW for ZeRO offloaded optimizer states.
+//
+// Parity: reference csrc/adam/cpu_adam_impl.cpp (Step_1/4/8 AVX widths over
+// pinned host memory). TPU-native stance: the TPU VM's CPUs step the
+// optimizer over fp32 master weights held in host RAM; vectorization is
+// left to the compiler (-O3 -march=native auto-vectorizes this loop to the
+// same AVX the reference hand-rolls), parallelism to OpenMP when present.
+
+#include <cmath>
+#include <cstdint>
+
+#if defined(_OPENMP)
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// One fused Adam(W) step over flat fp32 arrays.
+//   adamw_mode: 1 => decoupled weight decay (AdamW), 0 => L2-into-grad Adam
+// Bias correction follows the reference (step is 1-based).
+void ds_adam_step(float* params, const float* grads, float* exp_avg, float* exp_avg_sq, int64_t n, float lr,
+                  float beta1, float beta2, float eps, float weight_decay, int64_t step, int adamw_mode) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = std::sqrt(bc2);
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (!adamw_mode && weight_decay != 0.0f) g += weight_decay * params[i];
+    float m = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float v = beta2 * exp_avg_sq[i] + (1.0f - beta2) * g * g;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    // decoupled decay uses the raw lr (bias correction applies to the
+    // moment estimate only) — matches optax.adamw / torch AdamW
+    float decay = (adamw_mode && weight_decay != 0.0f) ? lr * weight_decay * params[i] : 0.0f;
+    params[i] -= step_size * (m / denom) + decay;
+  }
+}
+
+// Adagrad step (reference csrc/adagrad/cpu_adagrad.cpp).
+void ds_adagrad_step(float* params, const float* grads, float* sq_sum, int64_t n, float lr, float eps,
+                     float weight_decay) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    if (weight_decay != 0.0f) g += weight_decay * params[i];
+    float s = sq_sum[i] + g * g;
+    sq_sum[i] = s;
+    params[i] -= lr * g / (std::sqrt(s) + eps);
+  }
+}
+
+// Lion step (reference csrc/lion/cpu_lion_impl.cpp).
+void ds_lion_step(float* params, const float* grads, float* exp_avg, int64_t n, float lr, float beta1, float beta2,
+                  float weight_decay) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i];
+    float c = beta1 * exp_avg[i] + (1.0f - beta1) * g;
+    float update = (c > 0.0f) ? 1.0f : ((c < 0.0f) ? -1.0f : 0.0f);
+    if (weight_decay != 0.0f) update += weight_decay * params[i];
+    params[i] -= lr * update;
+    exp_avg[i] = beta2 * exp_avg[i] + (1.0f - beta2) * g;
+  }
+}
+
+int ds_omp_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"

@@ -147,23 +147,38 @@ def save_universal_checkpoint(engine, save_dir: str, tag: Optional[str] = None) 
     params_host = jax.device_get(engine.params)
     params_flat = flat_named_leaves(params_host)
     sig = leaf_signature(params_host)
-    opt_state_sd = to_state_dict(jax.device_get(engine.opt_state))
-    paths = find_param_shaped_subtrees(opt_state_sd, sig)
-    moments = []
-    for p in paths:
-        moments.append(flat_named_leaves(get_subtree(opt_state_sd, p)))
-        set_subtree(opt_state_sd, p, None)
-    scalar_state = {name: np.asarray(leaf)
-                    for name, leaf in iter_named_leaves(opt_state_sd)
-                    if leaf is not None and is_scalar_like(leaf)}
+    offload = getattr(engine, "_host_offload", None)
+    if offload is not None:
+        moments = [flat_named_leaves(to_state_dict(t)) for t in offload.moments_trees()]
+        scalar_state = {"__offload_step__": np.asarray(offload.step_count)}
+    else:
+        opt_state_sd = to_state_dict(jax.device_get(engine.opt_state))
+        paths = find_param_shaped_subtrees(opt_state_sd, sig)
+        moments = []
+        for p in paths:
+            moments.append(flat_named_leaves(get_subtree(opt_state_sd, p)))
+            set_subtree(opt_state_sd, p, None)
+        scalar_state = {name: np.asarray(leaf)
+                        for name, leaf in iter_named_leaves(opt_state_sd)
+                        if leaf is not None and is_scalar_like(leaf)}
     scalar_state["__loss_scaler__"] = engine.loss_scaler.state_dict()
     if engine.lr_scheduler is not None:
         scalar_state["__lr_scheduler__"] = engine.lr_scheduler.state_dict()
+    # mode-independent optimizer step (Adam bias correction must survive
+    # offload <-> device resumes): offload tracks it directly; optax keeps
+    # it in a 'count' scalar leaf
+    if offload is not None:
+        optim_step = int(offload.step_count)
+    else:
+        counts = [int(np.asarray(v)) for k, v in scalar_state.items()
+                  if not k.startswith("__") and k.split(SEP)[-1] == "count"]
+        optim_step = max(counts) if counts else engine.global_steps - engine.skipped_steps
     counters = {
         "global_steps": engine.global_steps,
         "micro_steps": engine.micro_steps,
         "global_samples": engine.global_samples,
         "skipped_steps": engine.skipped_steps,
+        "optim_step": optim_step,
     }
     return _write_universal(save_dir, tag, params_flat, moments, scalar_state, counters)
 
@@ -210,6 +225,39 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
     params_host = from_state_dict(template_host, unflatten_named(params_flat))
     engine.params = jax.device_put(params_host, engine.param_shardings)
 
+    offload = getattr(engine, "_host_offload", None)
+    if offload is not None:
+        offload.set_master(params_host)
+        if load_optimizer_states:
+            trees = []
+            for i in range(meta.get("n_moment_trees", 0)):
+                mom_flat = _read_flat(zdir, _moment_file(i), list(tmpl_flat.keys()))
+                if len(mom_flat) != len(tmpl_flat):
+                    lost = [n for n in tmpl_flat if n not in mom_flat]
+                    raise KeyError(f"universal checkpoint at {root} missing {_moment_file(i)} "
+                                   f"for params: {lost[:5]}...")
+                trees.append(from_state_dict(template_host, unflatten_named(mom_flat)))
+            offload.set_moments_trees(trees)
+            scalar_path = os.path.join(root, SCALAR_STATE)
+            counters0 = meta.get("counters", {})
+            if "optim_step" in counters0:
+                offload.step_count = int(counters0["optim_step"])
+            if os.path.exists(scalar_path):
+                with open(scalar_path, "rb") as f:
+                    scalar_state = pickle.load(f)
+                if "optim_step" not in counters0 and "__offload_step__" in scalar_state:
+                    offload.step_count = int(scalar_state["__offload_step__"])
+                if "__loss_scaler__" in scalar_state:
+                    engine.loss_scaler.load_state_dict(scalar_state["__loss_scaler__"])
+                if "__lr_scheduler__" in scalar_state and engine.lr_scheduler is not None:
+                    engine.lr_scheduler.load_state_dict(scalar_state["__lr_scheduler__"])
+            counters = meta.get("counters", {})
+            engine.global_steps = int(counters.get("global_steps", engine.global_steps))
+            engine.micro_steps = int(counters.get("micro_steps", engine.micro_steps))
+            engine.global_samples = int(counters.get("global_samples", engine.global_samples))
+            engine.skipped_steps = int(counters.get("skipped_steps", engine.skipped_steps))
+        return root
+
     if load_optimizer_states:
         opt_host = jax.device_get(engine.opt_state)
         opt_sd = to_state_dict(opt_host)
@@ -227,10 +275,15 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         if os.path.exists(scalar_path):
             with open(scalar_path, "rb") as f:
                 scalar_state = pickle.load(f)
+        optim_step = meta.get("counters", {}).get("optim_step")
         for name, leaf in list(iter_named_leaves(opt_sd)):
             if name in scalar_state and is_scalar_like(leaf):
                 parts = tuple(name.split(SEP))
                 set_subtree(opt_sd, parts, np.asarray(scalar_state[name], dtype=np.asarray(leaf).dtype))
+            elif (optim_step is not None and is_scalar_like(leaf) and name.split(SEP)[-1] == "count"):
+                # source engine had no optax state (e.g. host offload): restore
+                # the step counter so Adam bias correction continues correctly
+                set_subtree(opt_sd, tuple(name.split(SEP)), np.asarray(optim_step, dtype=np.asarray(leaf).dtype))
         engine.opt_state = jax.device_put(from_state_dict(opt_host, opt_sd), engine.opt_state_shardings)
         if "__loss_scaler__" in scalar_state:
             engine.loss_scaler.load_state_dict(scalar_state["__loss_scaler__"])

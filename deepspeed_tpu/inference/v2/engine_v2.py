@@ -69,6 +69,12 @@ class InferenceEngineV2:
         self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else jnp.float32
 
         smc = config.state_manager
+        if smc.max_context > cfg.max_seq_len:
+            # positions past max_seq_len would silently clamp the rope/wpe
+            # gathers under jit — cap the KV contract to the model's window
+            log_dist(f"max_context {smc.max_context} > model max_seq_len {cfg.max_seq_len}; capping", ranks=[0])
+            smc = dataclasses.replace(smc, max_context=cfg.max_seq_len)
+            config.state_manager = smc
         n_blocks = smc.num_kv_blocks
         if n_blocks is None:
             bytes_per_block = (2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * cfg.head_dim *

@@ -1,0 +1,3 @@
+from .cpu_adam import DeepSpeedCPUAdam, DeepSpeedCPUAdagrad, DeepSpeedCPULion
+
+__all__ = ["DeepSpeedCPUAdam", "DeepSpeedCPUAdagrad", "DeepSpeedCPULion"]

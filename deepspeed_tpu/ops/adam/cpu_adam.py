@@ -1,0 +1,110 @@
+"""Host (CPU) optimizers over offloaded fp32 states.
+
+Parity: reference ``deepspeed/ops/adam/cpu_adam.py`` (``DeepSpeedCPUAdam``:
+AVX Adam stepping optimizer states pinned in host RAM while the model
+lives on device) plus the adagrad/lion variants. Here the states are flat
+numpy fp32 arrays stepped by the C++ lib (``csrc/cpu_adam.cpp``), with a
+vectorized-numpy fallback when no toolchain is present; the ZeRO-offload
+engine path (``runtime/zero/offload.py``) owns the device<->host movement.
+"""
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..native.builder import get_native_lib
+
+_I64 = ctypes.c_int64
+_F = ctypes.c_float
+_PF = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+
+
+def _lib():
+    lib = get_native_lib("ds_cpu_optim")
+    if lib is not None and not getattr(lib, "_ds_sigs", False):
+        lib.ds_adam_step.argtypes = [_PF, _PF, _PF, _PF, _I64, _F, _F, _F, _F, _F, _I64, ctypes.c_int]
+        lib.ds_adagrad_step.argtypes = [_PF, _PF, _PF, _I64, _F, _F, _F]
+        lib.ds_lion_step.argtypes = [_PF, _PF, _PF, _I64, _F, _F, _F, _F]
+        lib._ds_sigs = True
+    return lib
+
+
+class DeepSpeedCPUAdam:
+    """Steps (params, exp_avg, exp_avg_sq) in place on the host."""
+
+    def __init__(self, lr: float = 1e-3, betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.0,
+                 adamw_mode: bool = True):
+        self.lr = lr
+        self.betas = tuple(betas)
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.adamw_mode = adamw_mode
+        self.step_count = 0
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray, exp_avg_sq: np.ndarray,
+             lr: Optional[float] = None, step: Optional[int] = None) -> None:
+        """One Adam step. ``step`` is the 1-based logical step shared by all
+        parameters of one optimizer step; when None the handle's counter
+        auto-advances (single-tensor usage)."""
+        if step is None:
+            self.step_count += 1
+            step = self.step_count
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        lib = _lib()
+        if lib is not None:
+            lib.ds_adam_step(params, grads, exp_avg, exp_avg_sq, params.size, lr, b1, b2, self.eps,
+                             self.weight_decay, step, int(self.adamw_mode))
+            return
+        # numpy fallback: identical math
+        g = grads
+        if not self.adamw_mode and self.weight_decay:
+            g = g + self.weight_decay * params
+        np.multiply(exp_avg, b1, out=exp_avg)
+        exp_avg += (1 - b1) * g
+        np.multiply(exp_avg_sq, b2, out=exp_avg_sq)
+        exp_avg_sq += (1 - b2) * np.square(g)
+        bc1 = 1 - b1**step
+        bc2 = 1 - b2**step
+        denom = np.sqrt(exp_avg_sq) / np.sqrt(bc2) + self.eps
+        update = (lr / bc1) * exp_avg / denom
+        if self.adamw_mode and self.weight_decay:
+            update = update + lr * self.weight_decay * params
+        params -= update
+
+
+class DeepSpeedCPUAdagrad:
+
+    def __init__(self, lr: float = 1e-2, eps: float = 1e-10, weight_decay: float = 0.0):
+        self.lr, self.eps, self.weight_decay = lr, eps, weight_decay
+
+    def step(self, params: np.ndarray, grads: np.ndarray, sq_sum: np.ndarray, lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        lib = _lib()
+        if lib is not None:
+            lib.ds_adagrad_step(params, grads, sq_sum, params.size, lr, self.eps, self.weight_decay)
+            return
+        g = grads + self.weight_decay * params if self.weight_decay else grads
+        sq_sum += np.square(g)
+        params -= lr * g / (np.sqrt(sq_sum) + self.eps)
+
+
+class DeepSpeedCPULion:
+
+    def __init__(self, lr: float = 1e-4, betas=(0.9, 0.99), weight_decay: float = 0.0):
+        self.lr, self.betas, self.weight_decay = lr, tuple(betas), weight_decay
+
+    def step(self, params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray, lr: Optional[float] = None) -> None:
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.betas
+        lib = _lib()
+        if lib is not None:
+            lib.ds_lion_step(params, grads, exp_avg, params.size, lr, b1, b2, self.weight_decay)
+            return
+        update = np.sign(b1 * exp_avg + (1 - b1) * grads)
+        if self.weight_decay:
+            update = update + self.weight_decay * params
+        params -= lr * update
+        np.multiply(exp_avg, b2, out=exp_avg)
+        exp_avg += (1 - b2) * grads

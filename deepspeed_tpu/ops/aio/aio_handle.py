@@ -1,0 +1,103 @@
+"""Async tensor file I/O handle.
+
+Parity: reference ``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` (the
+``aio_handle`` pybind API: async_pread/async_pwrite/wait over a libaio
+thread pool) + ``op_builder/async_io.py`` availability probing. Backed by
+the C++ thread pool in ``csrc/aio.cpp``; a synchronous numpy fallback
+keeps the API total on toolchain-less machines.
+"""
+
+import ctypes
+from typing import List, Optional
+
+import numpy as np
+
+from ..native.builder import get_native_lib, native_available
+
+
+def aio_available() -> bool:
+    return native_available("ds_aio")
+
+
+def _lib():
+    lib = get_native_lib("ds_aio")
+    if lib is not None and not getattr(lib, "_ds_sigs", False):
+        lib.ds_aio_handle_create.restype = ctypes.c_void_p
+        lib.ds_aio_handle_create.argtypes = [ctypes.c_int]
+        lib.ds_aio_handle_destroy.argtypes = [ctypes.c_void_p]
+        lib.ds_aio_pwrite.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+                                      ctypes.c_int64]
+        lib.ds_aio_pread.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p,
+                                     ctypes.c_int64]
+        lib.ds_aio_wait.restype = ctypes.c_int64
+        lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+        lib._ds_sigs = True
+    return lib
+
+
+class AsyncIOHandle:
+    """Submit overlapped reads/writes of numpy arrays; ``wait()`` to sync.
+
+    Buffers passed to async ops MUST stay alive until ``wait()`` returns —
+    the handle keeps references to enforce this.
+    """
+
+    def __init__(self, num_threads: int = 4):
+        self._lib = _lib()
+        self._h = self._lib.ds_aio_handle_create(num_threads) if self._lib is not None else None
+        self._pinned: List[np.ndarray] = []
+        self._sync_errors = 0
+
+    def async_pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> None:
+        arr = np.ascontiguousarray(arr)
+        if self._h is not None:
+            self._pinned.append(arr)
+            self._lib.ds_aio_pwrite(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, path.encode(), offset)
+        else:  # sync fallback
+            try:
+                with open(path, "r+b" if offset else "wb") as f:
+                    f.seek(offset)
+                    f.write(arr.tobytes())
+            except OSError:
+                try:
+                    with open(path, "wb") as f:
+                        f.seek(offset)
+                        f.write(arr.tobytes())
+                except OSError:
+                    self._sync_errors += 1
+
+    def async_pread(self, arr: np.ndarray, path: str, offset: int = 0) -> None:
+        assert arr.flags["C_CONTIGUOUS"], "read target must be contiguous"
+        if self._h is not None:
+            self._pinned.append(arr)
+            self._lib.ds_aio_pread(self._h, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, path.encode(), offset)
+        else:
+            try:
+                with open(path, "rb") as f:
+                    f.seek(offset)
+                    data = f.read(arr.nbytes)
+                arr.ravel()[:] = np.frombuffer(data, dtype=arr.dtype)
+            except (OSError, ValueError):
+                self._sync_errors += 1
+
+    def wait(self) -> int:
+        """Block until all in-flight ops finish; returns the failure count."""
+        if self._h is not None:
+            errors = int(self._lib.ds_aio_wait(self._h))
+        else:
+            errors = self._sync_errors
+            self._sync_errors = 0
+        self._pinned.clear()
+        return errors
+
+    def close(self) -> None:
+        if self._h is not None:
+            self.wait()
+            self._lib.ds_aio_handle_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,3 @@
+from .builder import NativeOpBuilder, get_native_lib, native_available
+
+__all__ = ["NativeOpBuilder", "get_native_lib", "native_available"]

@@ -1,0 +1,100 @@
+"""JIT builder for host-side C++ ops.
+
+Parity: reference ``op_builder/builder.py`` (``OpBuilder.load`` :108 JIT
+compiles csrc with ninja and caches the .so). TPU-native stance: the only
+native code is host-side (CPU optimizer for offloaded states, async NVMe
+I/O — SURVEY.md §2.2), so the builder is a thin g++ → shared-object step
+with a content-hash cache and ctypes loading; no vendor arch flags, no
+torch extension machinery. Kernel "ops" are Pallas (pure Python) and go
+through ``ops/registry.py`` instead.
+"""
+
+import ctypes
+import hashlib
+import os
+import subprocess
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ...utils.logging import logger
+
+# repo layout: csrc/ sits next to the package (reference keeps csrc/ at top level)
+CSRC_DIR = Path(__file__).resolve().parents[3] / "csrc"
+CACHE_DIR = Path(os.environ.get("DS_TPU_BUILD_DIR", Path.home() / ".cache" / "deepspeed_tpu" / "build"))
+
+_loaded: Dict[str, Optional[ctypes.CDLL]] = {}
+
+
+class NativeOpBuilder:
+    """One builder per .so; mirrors the reference's per-op builder classes."""
+
+    def __init__(self, name: str, sources: List[str], extra_flags: Optional[List[str]] = None):
+        self.name = name
+        self.sources = [CSRC_DIR / s for s in sources]
+        self.extra_flags = extra_flags or []
+
+    def _hash(self) -> str:
+        h = hashlib.sha256()
+        for s in self.sources:
+            h.update(s.read_bytes())
+        h.update(" ".join(self.extra_flags).encode())
+        return h.hexdigest()[:16]
+
+    def so_path(self) -> Path:
+        return CACHE_DIR / f"{self.name}-{self._hash()}.so"
+
+    def is_compatible(self) -> bool:
+        try:
+            return all(s.exists() for s in self.sources) and subprocess.run(
+                ["g++", "--version"], capture_output=True).returncode == 0
+        except (OSError, FileNotFoundError):
+            return False
+
+    def build(self) -> Path:
+        out = self.so_path()
+        if out.exists():
+            return out
+        CACHE_DIR.mkdir(parents=True, exist_ok=True)
+        base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", str(out)] + [str(s) for s in self.sources]
+        # best flags first; fall back for conservative toolchains
+        for flags in (["-march=native", "-fopenmp"], ["-fopenmp"], []):
+            cmd = base + flags + self.extra_flags
+            r = subprocess.run(cmd, capture_output=True, text=True)
+            if r.returncode == 0:
+                logger.info(f"built native op {self.name}: {' '.join(cmd)}")
+                return out
+        raise RuntimeError(f"g++ failed for {self.name}: {r.stderr[-2000:]}")
+
+    def load(self) -> ctypes.CDLL:
+        if self.name not in _loaded:
+            _loaded[self.name] = ctypes.CDLL(str(self.build()))
+        lib = _loaded[self.name]
+        if lib is None:
+            raise RuntimeError(f"native op {self.name} unavailable")
+        return lib
+
+
+_BUILDERS = {
+    "ds_cpu_optim": NativeOpBuilder("ds_cpu_optim", ["cpu_adam.cpp"]),
+    "ds_aio": NativeOpBuilder("ds_aio", ["aio.cpp"]),
+}
+
+
+def get_native_lib(name: str) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) a native lib; None if the toolchain/source
+    is unavailable — callers fall back to numpy implementations."""
+    if name in _loaded:
+        return _loaded[name]
+    builder = _BUILDERS[name]
+    try:
+        if not builder.is_compatible():
+            raise RuntimeError("no g++ toolchain or missing sources")
+        return builder.load()
+    except Exception as e:  # toolchain-less environments are supported
+        logger.warning(f"native op {name} unavailable ({e}); using Python fallback")
+        _loaded[name] = None
+        return None
+
+
+def native_available(name: str) -> bool:
+    return get_native_lib(name) is not None

@@ -122,9 +122,35 @@ class DeepSpeedEngine:
             raise TypeError("client optimizer must be an optax.GradientTransformation")
         self.optimizer = optimizer if optimizer is not None else create_optimizer(
             self.config.optimizer.type, self.config.optimizer.params)
-        opt_specs, _ = plan_opt_state_specs(self.optimizer, param_shapes, self.param_specs, self.config, self.topology)
-        self.opt_state_shardings = specs_to_shardings(opt_specs, self.topology)
-        self.opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_state_shardings)(self.params)
+
+        # ZeRO-Offload: optimizer states leave the device entirely
+        # (reference stage_1_and_2.py:1182-1277 cpu, stage3.py:1877 nvme)
+        self._host_offload = None
+        off = self.config.zero_config.offload_optimizer
+        if self.config.zero_enabled and off.device in ("cpu", "nvme"):
+            opt_name = (self.config.optimizer.type or "adamw").lower()
+            if optimizer is not None:
+                logger.warning("offload_optimizer requires a config-defined adam-family optimizer; a client "
+                               "optimizer object was passed — keeping optimizer states on device")
+            elif "adam" not in opt_name:
+                logger.warning(f"offload_optimizer supports adam-family optimizers; got {opt_name} — "
+                               "keeping optimizer states on device")
+            else:
+                from .zero.offload import HostOffloadOptimizer
+
+                self._host_offload = HostOffloadOptimizer(jax.device_get(self.params),
+                                                          self.config.optimizer.params, offload_device=off.device,
+                                                          nvme_path=off.nvme_path,
+                                                          aio_threads=self.config.aio.thread_count,
+                                                          pipeline=off.pipeline_read or off.pipeline_write)
+        if self._host_offload is None:
+            opt_specs, _ = plan_opt_state_specs(self.optimizer, param_shapes, self.param_specs, self.config,
+                                                self.topology)
+            self.opt_state_shardings = specs_to_shardings(opt_specs, self.topology)
+            self.opt_state = jax.jit(self.optimizer.init, out_shardings=self.opt_state_shardings)(self.params)
+        else:
+            self.opt_state_shardings = None
+            self.opt_state = None
 
         # --- lr scheduler ---
         self.lr_scheduler = lr_scheduler
@@ -291,8 +317,15 @@ class DeepSpeedEngine:
         # grads were pre-scaled by loss_scale/gas in forward; undo loss_scale
         # here (the 1/gas factor stays: summed micro-grads become the mean)
         inv_scale = 1.0 / self.loss_scaler.loss_scale
-        self.params, self.opt_state, gnorm, overflow = self._apply_updates(
-            self.params, self.opt_state, self._grad_acc, inv_scale, lr)
+        if self._host_offload is not None:
+            new_params, gnorm, overflow = self._host_offload.step(jax.device_get(self._grad_acc), lr,
+                                                                  inv_scale=inv_scale,
+                                                                  grad_clip=self.config.gradient_clipping)
+            if not overflow:
+                self.params = jax.device_put(new_params, self.param_shardings)
+        else:
+            self.params, self.opt_state, gnorm, overflow = self._apply_updates(
+                self.params, self.opt_state, self._grad_acc, inv_scale, lr)
         self._grad_acc = None
         overflow_host = bool(overflow)
         self._global_grad_norm = gnorm
@@ -412,7 +445,7 @@ class DeepSpeedEngine:
         self.checkpoint_engine.create(tag)
         self.checkpoint_engine.save(self.params, os.path.join(d, MODEL_STATES_FILENAME))
         optim_state = {
-            "opt_state": self.opt_state,
+            "opt_state": self.opt_state if self._host_offload is None else self._host_offload.state_dict(),
             "loss_scaler": self.loss_scaler.state_dict(),
             "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
             "global_steps": self.global_steps,
@@ -448,13 +481,17 @@ class DeepSpeedEngine:
             optim_path = os.path.join(d, OPTIM_STATES_FILENAME)
             if load_optimizer_states and os.path.exists(optim_path):
                 template = {
-                    "opt_state": self.opt_state,
+                    "opt_state": self.opt_state if self._host_offload is None else
+                    self._host_offload.template_state_dict(),
                     "loss_scaler": self.loss_scaler.state_dict(),
                     "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
                     "global_steps": 0, "micro_steps": 0, "global_samples": 0, "skipped_steps": 0,
                 }
                 state = self.checkpoint_engine.load(optim_path, template=jax.device_get(template))
-                self.opt_state = jax.device_put(state["opt_state"], self.opt_state_shardings)
+                if self._host_offload is not None:
+                    self._host_offload.load_state_dict(state["opt_state"])
+                else:
+                    self.opt_state = jax.device_put(state["opt_state"], self.opt_state_shardings)
                 self.loss_scaler.load_state_dict(state["loss_scaler"])
                 if load_lr_scheduler_states and self.lr_scheduler is not None and state["lr_scheduler"] is not None:
                     self.lr_scheduler.load_state_dict(state["lr_scheduler"])

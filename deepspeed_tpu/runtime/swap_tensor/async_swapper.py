@@ -1,0 +1,60 @@
+"""Generic async tensor <-> NVMe swapping.
+
+Parity: reference ``runtime/swap_tensor/async_swapper.py``
+(``AsyncTensorSwapper``: overlapped tensor writes through aio with buffer
+reuse). Tensors are numpy arrays; each named tensor maps to one file
+under the swap folder, and reads/writes ride the C++ AIO thread pool
+(``ops/aio``) so swapping overlaps with host compute.
+"""
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle
+
+
+class AsyncTensorSwapper:
+
+    def __init__(self, swap_folder: str, num_threads: int = 4):
+        self.swap_folder = swap_folder
+        os.makedirs(swap_folder, exist_ok=True)
+        self._handle = AsyncIOHandle(num_threads=num_threads)
+        self._shapes: Dict[str, Tuple[Tuple[int, ...], np.dtype]] = {}
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_folder, name.replace("/", "--") + ".swp")
+
+    def swap_out(self, name: str, arr: np.ndarray) -> None:
+        """Start writing ``arr`` to disk (async; call ``synchronize``)."""
+        arr = np.ascontiguousarray(arr)
+        self._shapes[name] = (arr.shape, arr.dtype)
+        self._handle.async_pwrite(arr, self._path(name))
+
+    def swap_in(self, name: str, out: Optional[np.ndarray] = None) -> np.ndarray:
+        """Start reading ``name`` into ``out`` (allocated if None). The
+        array contents are valid only after ``synchronize()``."""
+        shape, dtype = self._shapes[name]
+        if out is None:
+            out = np.empty(shape, dtype)
+        self._handle.async_pread(out, self._path(name))
+        return out
+
+    def contains(self, name: str) -> bool:
+        return name in self._shapes
+
+    def synchronize(self) -> None:
+        errors = self._handle.wait()
+        if errors:
+            raise IOError(f"{errors} tensor swap operations failed under {self.swap_folder}")
+
+    def release(self, name: str) -> None:
+        self._shapes.pop(name, None)
+        try:
+            os.remove(self._path(name))
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self._handle.close()
