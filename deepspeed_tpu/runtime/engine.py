@@ -187,6 +187,7 @@ class DeepSpeedEngine:
         self._rng = jax.random.PRNGKey(get_accelerator().initial_seed())
         self.checkpoint_engine = create_checkpoint_engine(self.config)
         self.monitor = self._configure_monitor()
+        self.flops_profiler = None  # built lazily at the configured profile step
 
         # --- training data ---
         if training_data is not None:
@@ -282,9 +283,16 @@ class DeepSpeedEngine:
         batch = self._put_batch(batch)
         rng = jax.random.fold_in(self._rng, self.micro_steps)
         scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
+        profiling = (self.config.flops_profiler.enabled
+                     and self.global_steps == self.config.flops_profiler.profile_step
+                     and self.micro_steps % self.gradient_accumulation_steps == 0)  # first micro-batch only
+        if profiling:
+            self._start_flops_profile(batch, rng, scale)
         loss, grads = self._fwd_bwd(self.params, batch, rng, scale)
         self._cached_grads = grads
         self._last_loss = loss
+        if profiling:
+            self._stop_flops_profile()
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -342,6 +350,25 @@ class DeepSpeedEngine:
             self.monitor.write_events([("Train/Samples/lr", lr, self.global_samples)])
             if self._last_loss is not None:
                 self.monitor.write_events([("Train/Samples/train_loss", float(self._last_loss), self.global_samples)])
+
+    def _start_flops_profile(self, batch, rng, scale):
+        """Reference ``engine.py:1800,1817``: flops profiler on a configured step.
+        The profiled unit here is the fused fwd+bwd jit (what actually runs)."""
+        from ..profiling.flops_profiler import FlopsProfiler
+
+        self.flops_profiler = FlopsProfiler(ds_engine=self,
+                                            recompute_fwd_factor=self.config.flops_profiler.recompute_fwd_factor)
+        self.flops_profiler.analyze_fn(lambda p, b, r, s: self._fwd_bwd(p, b, r, s),
+                                       self.params, batch, rng, scale, params_tree=self.params)
+        self.flops_profiler.start_profile()
+
+    def _stop_flops_profile(self):
+        prof = self.flops_profiler
+        prof.stop_profile()
+        cfg = self.config.flops_profiler
+        prof.print_model_profile(profile_step=self.global_steps, module_depth=cfg.module_depth,
+                                 top_modules=cfg.top_modules, detailed=cfg.detailed, output_file=cfg.output_file)
+        prof.end_profile()
 
     def _next_lr(self) -> float:
         if self.lr_scheduler is not None:
@@ -476,6 +503,10 @@ class DeepSpeedEngine:
         params_host = self.checkpoint_engine.load(os.path.join(d, MODEL_STATES_FILENAME),
                                                   template=jax.device_get(self.params))
         self.params = jax.device_put(params_host, self.param_shardings)
+        if self._host_offload is not None:
+            # keep the host master copies in sync even when optimizer states
+            # are not loaded, or the next step reverts to init-time weights
+            self._host_offload.set_master(params_host)
         client_state = {}
         if not load_module_only:
             optim_path = os.path.join(d, OPTIM_STATES_FILENAME)

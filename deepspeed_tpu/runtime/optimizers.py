@@ -59,8 +59,15 @@ def create_optimizer(name: Optional[str], params: Optional[Dict] = None) -> opta
         a = _adam_args(params)
         adam_mode = params.get("adam_w_mode", True)
         if not adam_mode:
-            return optax.inject_hyperparams(optax.adam)(learning_rate=a["learning_rate"], b1=a["b1"], b2=a["b2"],
-                                                        eps=a["eps"])
+            # classic L2 (non-decoupled): decay folds into the gradient before
+            # the moments — must match HostOffloadOptimizer's adamw_mode=False
+            def adam_l2(learning_rate, b1, b2, eps, weight_decay):
+                return optax.chain(optax.add_decayed_weights(weight_decay),
+                                   optax.scale_by_adam(b1=b1, b2=b2, eps=eps),
+                                   optax.scale(-1.0 * learning_rate))
+
+            return optax.inject_hyperparams(adam_l2)(learning_rate=a["learning_rate"], b1=a["b1"], b2=a["b2"],
+                                                     eps=a["eps"], weight_decay=a["weight_decay"])
         return optax.inject_hyperparams(optax.adamw)(**a)
     if name == ADAMW_OPTIMIZER:
         return optax.inject_hyperparams(optax.adamw)(**_adam_args(params))

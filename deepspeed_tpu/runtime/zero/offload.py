@@ -78,10 +78,12 @@ class HostOffloadOptimizer:
                 self._adam.step(m, np.ascontiguousarray(g), st["exp_avg"], st["exp_avg_sq"], lr=lr, step=step)
         else:
             # pipelined: prefetch param i+1 states while stepping param i
-            self._swapper.prefetch(self._names[0], _STATE_NAMES)
+            # (plain blocking fetch per param when pipelining is disabled)
+            if self._swapper.pipeline:
+                self._swapper.prefetch(self._names[0], _STATE_NAMES)
             for i, (m, g) in enumerate(zip(self._master, grads)):
                 st = self._swapper.fetch(self._names[i], _STATE_NAMES)
-                if i + 1 < len(self._master):
+                if self._swapper.pipeline and i + 1 < len(self._master):
                     self._swapper.prefetch(self._names[i + 1], _STATE_NAMES)
                 self._adam.step(m, np.ascontiguousarray(g), st["exp_avg"], st["exp_avg_sq"], lr=lr, step=step)
                 self._swapper.commit(self._names[i], st)
