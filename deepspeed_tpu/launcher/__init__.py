@@ -1,0 +1,3 @@
+from .runner import fetch_hostfile, parse_inclusion_exclusion, parse_resource_filter
+
+__all__ = ["fetch_hostfile", "parse_inclusion_exclusion", "parse_resource_filter"]

@@ -1,0 +1,155 @@
+"""Multi-node launch backends.
+
+Parity: reference ``deepspeed/launcher/multinode_runner.py`` (PDSH :51,
+OpenMPI :118, MPICH :171, Slurm :303). Each runner turns (environment,
+resource pool) into one shell command that starts ``launch.py`` on every
+node. TPU-native addition: ``GCloudRunner`` drives ``gcloud compute tpus
+tpu-vm ssh --worker=all`` — the idiomatic way onto a TPU pod slice, where
+every host runs ONE process that owns its local chips (vs. the reference's
+one process per device).
+"""
+
+import os
+import shutil
+import shlex
+import sys
+from abc import ABC, abstractmethod
+from typing import Dict, List
+
+from ..utils.logging import logger
+
+
+class MultiNodeRunner(ABC):
+
+    def __init__(self, args, world_info_base64: str):
+        self.args = args
+        self.world_info_base64 = world_info_base64
+        self.user_arguments = list(getattr(args, "user_args", []) or [])
+        self.user_script = getattr(args, "user_script", "")
+        self.exports: Dict[str, str] = {}
+
+    @abstractmethod
+    def backend_exists(self) -> bool:
+        ...
+
+    @abstractmethod
+    def get_cmd(self, environment: Dict[str, str], active_resources: Dict[str, List[int]]) -> List[str]:
+        ...
+
+    def add_export(self, key: str, var: str) -> None:
+        self.exports[key.strip()] = str(var).strip()
+
+    @property
+    def name(self) -> str:
+        return self.__class__.__name__.replace("Runner", "").lower()
+
+    def _launch_cmd(self) -> List[str]:
+        # sys.executable assumes a homogeneous cluster (same interpreter
+        # path on every host) — same assumption the reference makes
+        cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
+               f"--world_info={self.world_info_base64}",
+               f"--master_addr={self.args.master_addr}",
+               f"--master_port={self.args.master_port}"]
+        if getattr(self.args, "module", False):
+            cmd.append("--module")
+        if getattr(self.args, "no_python", False):
+            cmd.append("--no_python")
+        return cmd + [self.user_script] + self.user_arguments
+
+
+class PDSHRunner(MultiNodeRunner):
+
+    def backend_exists(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, environment, active_resources):
+        environment["PDSH_RCMD_TYPE"] = "ssh"
+        hosts = ",".join(active_resources.keys())
+        exports = "".join(f"export {k}={shlex.quote(v)}; " for k, v in self.exports.items())
+        # pdsh runs the same line on every host; launch.py picks its node
+        # rank out of the world info by hostname
+        return ["pdsh", "-S", "-f", "1024", "-w", hosts, exports + " ".join(map(shlex.quote, self._launch_cmd()))]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+
+    def backend_exists(self) -> bool:
+        return shutil.which("ompi_info") is not None
+
+    def get_cmd(self, environment, active_resources):
+        # --host with the FILTERED set (not the raw hostfile): ranks must
+        # land only on hosts that survived --include/--exclude
+        total_procs = len(active_resources)  # one process per host (TPU idiom)
+        host_list = ",".join(f"{h}:1" for h in active_resources)
+        cmd = ["mpirun", "-n", str(total_procs), "--host", host_list, "--mca", "btl", "^openib"]
+        for k, v in self.exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        return cmd + self._launch_cmd()
+
+
+class MPICHRunner(MultiNodeRunner):
+
+    def backend_exists(self) -> bool:
+        return shutil.which("mpirun") is not None and shutil.which("ompi_info") is None
+
+    def get_cmd(self, environment, active_resources):
+        cmd = ["mpirun", "-n", str(len(active_resources)), "-hosts", ",".join(active_resources)]
+        for k, v in self.exports.items():
+            cmd += ["-genv", k, v]
+        return cmd + self._launch_cmd()
+
+
+class SlurmRunner(MultiNodeRunner):
+
+    def backend_exists(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, environment, active_resources):
+        # the filtered host set goes through --nodelist (srun has no
+        # --include, and ds_tpu's chip-selector syntax is not a hostlist)
+        cmd = ["srun", "-n", str(len(active_resources)), "--ntasks-per-node=1",
+               f"--nodelist={','.join(active_resources)}"]
+        exports = ",".join(f"{k}={v}" for k, v in self.exports.items())
+        if exports:
+            cmd += [f"--export=ALL,{exports}"]
+        return cmd + self._launch_cmd()
+
+
+class GCloudRunner(MultiNodeRunner):
+    """``gcloud compute tpus tpu-vm ssh --worker=all``: run the per-host
+    launcher on every worker of a TPU pod slice in one shot."""
+
+    def __init__(self, args, world_info_base64):
+        super().__init__(args, world_info_base64)
+        self.tpu_name = getattr(args, "tpu_name", None) or os.environ.get("TPU_NAME", "")
+        self.zone = getattr(args, "zone", None) or os.environ.get("TPU_ZONE", "")
+
+    def backend_exists(self) -> bool:
+        return shutil.which("gcloud") is not None and bool(self.tpu_name)
+
+    def get_cmd(self, environment, active_resources):
+        exports = "".join(f"export {k}={shlex.quote(v)}; " for k, v in self.exports.items())
+        remote = exports + " ".join(map(shlex.quote, self._launch_cmd()))
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh", self.tpu_name, "--worker=all", f"--command={remote}"]
+        if self.zone:
+            cmd.append(f"--zone={self.zone}")
+        return cmd
+
+
+RUNNER_CLASSES = {
+    "pdsh": PDSHRunner,
+    "openmpi": OpenMPIRunner,
+    "mpich": MPICHRunner,
+    "slurm": SlurmRunner,
+    "gcloud": GCloudRunner,
+}
+
+
+def select_runner(launcher: str, args, world_info_base64: str) -> MultiNodeRunner:
+    name = (launcher or "pdsh").lower()
+    if name not in RUNNER_CLASSES:
+        raise ValueError(f"unknown launcher {launcher!r}; choose from {sorted(RUNNER_CLASSES)}")
+    runner = RUNNER_CLASSES[name](args, world_info_base64)
+    if not runner.backend_exists():
+        logger.warning(f"launcher backend '{name}' not found on PATH")
+    return runner

@@ -1,0 +1,200 @@
+"""`ds_tpu` CLI: multi-host job launcher.
+
+Parity: reference ``deepspeed/launcher/runner.py`` (hostfile parse :200,
+include/exclude filters :255, main :388). Differences are TPU idioms:
+- "slots" are chips per host; the launcher starts ONE process per host
+  (JAX owns all local chips), not one per device.
+- default backend ladder: gcloud (TPU pod) -> pdsh -> slurm -> mpi.
+- rendezvous env is MASTER_ADDR/PORT + WORLD_SIZE/RANK, consumed by
+  ``deepspeed_tpu.comm.init_distributed`` -> ``jax.distributed``.
+"""
+
+import argparse
+import base64
+import json
+import os
+import re
+import subprocess
+import sys
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "TPU_NAME", "JAX_PLATFORMS", "XLA_FLAGS",
+               "LIBTPU_INIT_ARGS", "DS_ACCELERATOR"]
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="ds_tpu: launch a deepspeed_tpu training job over multiple TPU hosts",
+        formatter_class=argparse.ArgumentDefaultsHelpFormatter)
+    parser.add_argument("-H", "--hostfile", type=str, default=DLTS_HOSTFILE,
+                        help="hostfile of 'hostname slots=N' lines (N = chips on that host)")
+    parser.add_argument("-i", "--include", type=str, default="",
+                        help="subset of hosts/chips, e.g. 'host1@host2:0,2' (chip lists are "
+                        "informational on TPU: one process owns all of a host's chips)")
+    parser.add_argument("-e", "--exclude", type=str, default="", help="hosts/chips to exclude; mutually "
+                        "exclusive with --include")
+    parser.add_argument("--num_nodes", type=int, default=-1, help="limit to first N hosts")
+    parser.add_argument("--num_gpus", "--num_chips", dest="num_gpus", type=int, default=-1,
+                        help="limit chips per host")
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--master_addr", type=str, default="")
+    parser.add_argument("--launcher", type=str, default="",
+                        help="pdsh|openmpi|mpich|slurm|gcloud (default: auto-detect)")
+    parser.add_argument("--tpu_name", type=str, default="", help="TPU pod name for the gcloud launcher")
+    parser.add_argument("--zone", type=str, default="", help="GCE zone for the gcloud launcher")
+    parser.add_argument("--module", action="store_true", help="run user_script as 'python -m'")
+    parser.add_argument("--no_python", action="store_true", help="exec user_script directly")
+    parser.add_argument("--autotuning", type=str, default="", choices=["", "tune", "run"],
+                        help="run the autotuner instead of a plain launch")
+    parser.add_argument("--elastic_training", action="store_true",
+                        help="validate world size against the elastic config before launching")
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("user_script", type=str, nargs="?", default="", help="training script")
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
+    """Parse 'hostname slots=N' lines -> {host: slots} (reference :200)."""
+    if not os.path.isfile(hostfile_path):
+        return None
+    resource_pool: "OrderedDict[str, int]" = OrderedDict()
+    with open(hostfile_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            m = re.match(r"^(\S+)\s+slots=(\d+)$", line)
+            if m is None:
+                raise ValueError(f"hostfile line not of the form 'host slots=N': {line!r}")
+            host, slots = m.group(1), int(m.group(2))
+            if host in resource_pool:
+                raise ValueError(f"host {host} appears twice in hostfile")
+            resource_pool[host] = slots
+    return resource_pool or None
+
+
+def _parse_host_spec(spec: str, resource_pool: Dict[str, int]) -> Dict[str, List[int]]:
+    """'host1@host2:0,2' -> {host1: all chips, host2: [0, 2]}."""
+    out: Dict[str, List[int]] = OrderedDict()
+    for part in filter(None, spec.split("@")):
+        if ":" in part:
+            host, chips = part.split(":", 1)
+            chip_list = [int(c) for c in chips.split(",") if c != ""]
+        else:
+            host, chip_list = part, None
+        if host not in resource_pool:
+            raise ValueError(f"host {host!r} not in hostfile {sorted(resource_pool)}")
+        slots = resource_pool[host]
+        if chip_list is None:
+            chip_list = list(range(slots))
+        for c in chip_list:
+            if not 0 <= c < slots:
+                raise ValueError(f"chip {c} out of range for host {host} (slots={slots})")
+        if host in out:
+            raise ValueError(f"host {host} appears twice in selector {spec!r}")
+        out[host] = sorted(set(chip_list))
+    return out
+
+
+def parse_resource_filter(resource_pool: Dict[str, int], include_str: str = "",
+                          exclude_str: str = "") -> Dict[str, List[int]]:
+    """Apply --include / --exclude (reference :255). Returns {host: chips}."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    full = OrderedDict((h, list(range(s))) for h, s in resource_pool.items())
+    if include_str:
+        return _parse_host_spec(include_str, resource_pool)
+    if exclude_str:
+        excluded = _parse_host_spec(exclude_str, resource_pool)
+        out = OrderedDict()
+        for host, chips in full.items():
+            if host in excluded:
+                keep = [c for c in chips if c not in excluded[host]]
+                # excluding every chip drops the host entirely
+                if keep and len(excluded[host]) < len(chips):
+                    out[host] = keep
+            else:
+                out[host] = chips
+        return out
+    return full
+
+
+def parse_inclusion_exclusion(resource_pool: Dict[str, int], inclusion: str,
+                              exclusion: str) -> Dict[str, List[int]]:
+    return parse_resource_filter(resource_pool, include_str=inclusion or "", exclude_str=exclusion or "")
+
+
+def encode_world_info(active_resources: Dict[str, List[int]]) -> str:
+    return base64.urlsafe_b64encode(json.dumps(active_resources).encode()).decode()
+
+
+def main(args=None):
+    args = parse_args(args)
+    if args.no_python and args.module:
+        raise ValueError("--no_python and --module are mutually exclusive")
+    resource_pool = fetch_hostfile(args.hostfile)
+
+    # single-host path: no hostfile -> exec locally, no ssh
+    if resource_pool is None and not args.force_multi:
+        env = os.environ.copy()
+        env.setdefault("MASTER_ADDR", "127.0.0.1")
+        env.setdefault("MASTER_PORT", str(args.master_port))
+        cmd = ([] if args.no_python else [sys.executable, "-u"]) + (["-m"] if args.module else [])
+        cmd.append(args.user_script)
+        cmd += args.user_args
+        logger.info(f"ds_tpu single-host launch: {' '.join(cmd)}")
+        result = subprocess.Popen(cmd, env=env)
+        result.wait()
+        return result.returncode
+
+    if resource_pool is None:
+        raise RuntimeError(f"--force_multi needs a hostfile at {args.hostfile}")
+
+    if args.num_nodes > 0:
+        resource_pool = OrderedDict(list(resource_pool.items())[:args.num_nodes])
+    if args.num_gpus > 0:
+        resource_pool = OrderedDict((h, min(s, args.num_gpus)) for h, s in resource_pool.items())
+
+    active_resources = parse_resource_filter(resource_pool, args.include, args.exclude)
+    if not active_resources:
+        raise RuntimeError("no hosts left after include/exclude filtering")
+
+    world_chips = sum(len(v) for v in active_resources.values())
+    if args.elastic_training:
+        from ..elasticity import compute_elastic_config
+
+        # raises if the chip count is incompatible with the elastic config
+        ds_config_path = next((a for a in args.user_args if a.endswith(".json")), None)
+        if ds_config_path and os.path.isfile(ds_config_path):
+            with open(ds_config_path) as f:
+                compute_elastic_config(json.load(f), world_size=world_chips)
+
+    if not args.master_addr:
+        args.master_addr = next(iter(active_resources))
+
+    world_info = encode_world_info(active_resources)
+    from .multinode_runner import select_runner
+
+    launcher = args.launcher
+    if not launcher:
+        launcher = "gcloud" if (args.tpu_name or os.environ.get("TPU_NAME")) else "pdsh"
+    runner = select_runner(launcher, args, world_info)
+    env = os.environ.copy()
+    for var in EXPORT_ENVS:
+        if var in env:
+            runner.add_export(var, env[var])
+    cmd = runner.get_cmd(env, active_resources)
+    logger.info(f"ds_tpu {runner.name} launch ({len(active_resources)} hosts, {world_chips} chips): "
+                f"{' '.join(cmd)}")
+    result = subprocess.Popen(cmd, env=env)
+    result.wait()
+    return result.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,8 +74,11 @@ class _Registry:
         """``ds_report`` analogue: one line per op with chosen + alternates."""
         import jax
 
-        lines = ["-" * 60, "deepspeed_tpu op report", "-" * 60,
-                 f"jax backend: {jax.default_backend()} | devices: {jax.device_count()}", "-" * 60]
+        try:
+            backend_line = f"jax backend: {jax.default_backend()} | devices: {jax.device_count()}"
+        except Exception as e:  # noqa: BLE001 - report the breakage, don't crash the report
+            backend_line = f"jax backend: UNAVAILABLE ({e})"
+        lines = ["-" * 60, "deepspeed_tpu op report", "-" * 60, backend_line, "-" * 60]
         for op_name, impls in sorted(self._ops.items()):
             try:
                 chosen = self.selected(op_name)

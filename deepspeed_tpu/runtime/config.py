@@ -283,6 +283,10 @@ class ElasticityConfig(DeepSpeedConfigModel):
     prefer_larger_batch: bool = True
     ignore_non_elastic_batch_info: bool = False
     version: float = 0.1
+    # v0.2 (node-granular) knobs; "gpus" kept for config-key parity — on TPU
+    # these count chips
+    num_gpus_per_node: int = 1
+    model_parallel_size: int = 1
 
 
 def _load_config_dict(config: Union[str, Dict]) -> Dict:

@@ -1,0 +1,117 @@
+"""Elasticity solver tests.
+
+Mirrors reference ``tests/unit/elasticity/test_elastic.py``: v0.1 solver
+invariants (every valid count divides batch/micro), v0.2 node granularity
++ model parallelism, world-size compatibility errors, immutability check.
+"""
+
+import json
+
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config, elasticity_enabled, ensure_immutable_elastic_config,
+                                      ElasticityConfigError, ElasticityIncompatibleWorldSize)
+
+BASE = {
+    "elasticity": {
+        "enabled": True,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2, 4, 6],
+        "min_gpus": 1,
+        "max_gpus": 10000,
+        "version": 0.1,
+    }
+}
+
+
+def test_basic_solver():
+    batch, valid = compute_elastic_config(BASE)
+    assert batch <= 2000 and batch > 0
+    assert valid, "no valid chip counts"
+    # invariant: every valid count admits some micro batch with integral gas
+    for w in valid:
+        assert any(batch % (m * w) == 0 for m in [2, 4, 6]), (batch, w)
+
+
+def test_world_size_compatibility():
+    batch, valid = compute_elastic_config(BASE)
+    ok_ws = valid[0]
+    b, v, micro = compute_elastic_config(BASE, world_size=ok_ws, return_microbatch=True)
+    assert b == batch and micro in [2, 4, 6]
+    bad_ws = max(valid) + 1
+    while bad_ws in valid:
+        bad_ws += 1
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(BASE, world_size=bad_ws)
+
+
+def test_prefer_larger_false_gives_smaller_batch():
+    cfg_small = json.loads(json.dumps(BASE))
+    cfg_small["elasticity"]["prefer_larger_batch"] = False
+    b_small, _ = compute_elastic_config(cfg_small)
+    b_large, _ = compute_elastic_config(BASE)
+    assert b_small <= b_large
+
+
+def test_disabled_and_missing():
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({"elasticity": {"enabled": False}})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config({})
+    assert not elasticity_enabled({})
+    assert elasticity_enabled(BASE)
+
+
+def test_version_02_node_granularity():
+    cfg = json.loads(json.dumps(BASE))
+    cfg["elasticity"].update({"version": 0.2, "num_gpus_per_node": 4, "model_parallel_size": 2})
+    batch, valid, micro = compute_elastic_config(cfg, world_size=8, return_microbatch=True)
+    dp_per_node = 4 // 2
+    assert all(v % dp_per_node == 0 for v in valid)
+    assert micro in [2, 4, 6]
+    assert batch > 0
+
+
+def test_version_02_subnode_world_raises():
+    cfg = json.loads(json.dumps(BASE))
+    cfg["elasticity"].update({"version": 0.2, "num_gpus_per_node": 8})
+    with pytest.raises(ElasticityIncompatibleWorldSize):
+        compute_elastic_config(cfg, world_size=4)
+
+
+def test_version_02_chip_count_units():
+    cfg = json.loads(json.dumps(BASE))
+    cfg["elasticity"].update({"version": 0.2, "num_gpus_per_node": 4, "model_parallel_size": 2})
+    _, valid, _ = compute_elastic_config(cfg, world_size=8, return_microbatch=True)
+    # valid counts are CHIPS (v0.1 units), so whole nodes of 4
+    assert all(v % 4 == 0 for v in valid)
+    assert 8 in valid
+
+
+def test_hcn_table_matches_sieve():
+    from deepspeed_tpu.elasticity.elasticity import _HCN_TABLE, _sieve_highly_composite
+
+    assert _sieve_highly_composite(5041) == [n for n in _HCN_TABLE if n <= 5041]
+
+
+def test_version_02_requires_divisible_mp():
+    cfg = json.loads(json.dumps(BASE))
+    cfg["elasticity"].update({"version": 0.2, "num_gpus_per_node": 4, "model_parallel_size": 3})
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg, world_size=8)
+
+
+def test_mp_unsupported_in_v01():
+    cfg = json.loads(json.dumps(BASE))
+    cfg["elasticity"]["model_parallel_size"] = 2
+    with pytest.raises(ElasticityConfigError):
+        compute_elastic_config(cfg)
+
+
+def test_immutability_check(monkeypatch):
+    ecd = BASE["elasticity"]
+    monkeypatch.setenv("DEEPSPEED_ELASTICITY_CONFIG", json.dumps(ecd))
+    ensure_immutable_elastic_config(ecd)  # match: no raise
+    changed = dict(ecd, max_train_batch_size=4000)
+    with pytest.raises(ElasticityConfigError):
+        ensure_immutable_elastic_config(changed)

@@ -1,0 +1,129 @@
+"""Launcher + env-report tests.
+
+Mirrors reference ``tests/unit/launcher/test_ds_arguments.py`` and
+``test_multinode_runner.py``: hostfile parsing, include/exclude filters,
+runner command construction, world-info round-trip — no ssh needed.
+"""
+
+import base64
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher import fetch_hostfile, parse_resource_filter
+from deepspeed_tpu.launcher.launch import build_child_env, decode_world_info, resolve_node_rank
+from deepspeed_tpu.launcher.multinode_runner import select_runner
+from deepspeed_tpu.launcher.runner import encode_world_info, parse_args
+
+
+def _hostfile(tmp_path, text):
+    p = tmp_path / "hostfile"
+    p.write_text(text)
+    return str(p)
+
+
+def test_fetch_hostfile(tmp_path):
+    path = _hostfile(tmp_path, "# comment\nworker-0 slots=4\nworker-1 slots=4\n\n")
+    pool = fetch_hostfile(path)
+    assert pool == {"worker-0": 4, "worker-1": 4}
+    assert list(pool) == ["worker-0", "worker-1"]  # order preserved
+
+
+def test_fetch_hostfile_missing_and_bad(tmp_path):
+    assert fetch_hostfile(str(tmp_path / "nope")) is None
+    bad = _hostfile(tmp_path, "worker-0 slots=four\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(bad)
+    dup = _hostfile(tmp_path, "w slots=2\nw slots=2\n")
+    with pytest.raises(ValueError):
+        fetch_hostfile(dup)
+
+
+def test_include_filter():
+    pool = {"w0": 4, "w1": 4, "w2": 4}
+    active = parse_resource_filter(pool, include_str="w0@w2:1,3")
+    assert active == {"w0": [0, 1, 2, 3], "w2": [1, 3]}
+
+
+def test_exclude_filter():
+    pool = {"w0": 4, "w1": 4}
+    active = parse_resource_filter(pool, exclude_str="w1")
+    assert active == {"w0": [0, 1, 2, 3]}
+    active = parse_resource_filter(pool, exclude_str="w1:0,1")
+    assert active == {"w0": [0, 1, 2, 3], "w1": [2, 3]}
+
+
+def test_filter_errors():
+    pool = {"w0": 2}
+    with pytest.raises(ValueError):
+        parse_resource_filter(pool, include_str="w0", exclude_str="w0")
+    with pytest.raises(ValueError):
+        parse_resource_filter(pool, include_str="unknown")
+    with pytest.raises(ValueError):
+        parse_resource_filter(pool, include_str="w0:7")
+
+
+def test_world_info_roundtrip_and_node_rank():
+    active = {"w0": [0, 1], "w1": [0, 1]}
+    b64 = encode_world_info(active)
+    assert decode_world_info(b64) == active
+    assert resolve_node_rank(active, node_rank=1) == 1
+    env = build_child_env(active, 1, "w0", 29500)
+    assert env["RANK"] == "1" and env["WORLD_SIZE"] == "2"
+    assert env["MASTER_ADDR"] == "w0" and env["DS_TPU_LOCAL_CHIPS"] == "0,1"
+    assert env["DS_TPU_WORLD_CHIPS"] == "4"  # chips, not hosts (elasticity input)
+
+
+def test_no_python_module_conflict(tmp_path):
+    from deepspeed_tpu.launcher.runner import main
+
+    with pytest.raises(ValueError):
+        main(["--no_python", "--module", "pkg.train"])
+
+
+def test_resolve_node_rank_from_scheduler_env(monkeypatch):
+    monkeypatch.setenv("SLURM_NODEID", "3")
+    assert resolve_node_rank({"a": [0], "b": [0], "c": [0], "d": [0]}) == 3
+
+
+def test_runner_commands(tmp_path):
+    hostfile = _hostfile(tmp_path, "w0 slots=4\nw1 slots=4\n")
+    args = parse_args(["-H", hostfile, "--master_addr", "w0", "train.py", "--lr", "0.1"])
+    active = {"w0": [0, 1, 2, 3], "w1": [0, 1, 2, 3]}
+    world = encode_world_info(active)
+
+    pdsh = select_runner("pdsh", args, world).get_cmd({}, active)
+    assert pdsh[0] == "pdsh" and "w0,w1" in pdsh
+    assert any("deepspeed_tpu.launcher.launch" in p for p in pdsh)
+
+    slurm = select_runner("slurm", args, world).get_cmd({}, active)
+    assert slurm[0] == "srun" and "--ntasks-per-node=1" in slurm
+    assert "--nodelist=w0,w1" in slurm
+
+    mpi = select_runner("openmpi", args, world).get_cmd({}, active)
+    assert mpi[0] == "mpirun" and "2" in mpi
+    # ranks must be pinned to the FILTERED host set, not the raw hostfile
+    assert "w0:1,w1:1" in mpi and str(hostfile) not in mpi
+
+    mpich = select_runner("mpich", args, world).get_cmd({}, active)
+    assert "w0,w1" in mpich
+
+    args.tpu_name = "my-pod"
+    gcloud = select_runner("gcloud", args, world).get_cmd({}, active)
+    assert gcloud[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
+    assert "--worker=all" in gcloud
+
+    with pytest.raises(ValueError):
+        select_runner("bogus", args, world)
+
+
+def test_env_report_smoke():
+    from deepspeed_tpu.env_report import report_string
+
+    text = report_string()
+    assert "deepspeed_tpu environment report" in text
+    assert "jax" in text
+    assert "op report" in text  # registry section present
