@@ -153,10 +153,13 @@ def _compatible_gpus_v02(micro_batches: List[int], max_acceptable_batch_size: in
             f"node ({num_gpus_per_node} chips)")
     dp_per_node = num_gpus_per_node // model_parallel_size
 
+    current_dp_replicas = current_num_gpus // model_parallel_size
+
     def microbatch_for(batch: int) -> Optional[int]:
+        # batch is consumed per data-parallel replica (chips/mp), not per chip
         chosen = None
         for micro in micro_batches:
-            if (batch // current_num_gpus) % micro == 0:
+            if (batch // current_dp_replicas) % micro == 0:
                 if chosen is None or (prefer_larger and micro > chosen):
                     chosen = micro
         return chosen
@@ -236,12 +239,13 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = __ve
     elif float(cfg.version) == 0.2:
         current = world_size
         if current == 0:
-            # DS_TPU_WORLD_CHIPS is the total chip count set by the launcher;
-            # WORLD_SIZE is the process (host) count under one-proc-per-host
-            env = os.getenv("DS_TPU_WORLD_CHIPS", "") or os.getenv("WORLD_SIZE", "")
+            # only DS_TPU_WORLD_CHIPS counts chips; WORLD_SIZE is the process
+            # (host) count under one-proc-per-host and must not be trusted here
+            env = os.getenv("DS_TPU_WORLD_CHIPS", "")
             if not env.isnumeric():
                 raise ElasticityConfigError(
-                    "elasticity v0.2 needs the chip count (world_size arg, or DS_TPU_WORLD_CHIPS / WORLD_SIZE env)")
+                    "elasticity v0.2 needs the total chip count: pass world_size or launch via ds_tpu "
+                    "(which sets DS_TPU_WORLD_CHIPS)")
             current = int(env)
         final_batch, valid_gpus, micro_batch = _compatible_gpus_v02(
             cfg.micro_batch_sizes, cfg.max_train_batch_size, current, cfg.min_gpus, cfg.max_gpus,

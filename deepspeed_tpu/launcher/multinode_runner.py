@@ -145,11 +145,22 @@ RUNNER_CLASSES = {
 }
 
 
+_AUTO_DETECT_ORDER = ["gcloud", "pdsh", "slurm", "openmpi", "mpich"]
+
+
 def select_runner(launcher: str, args, world_info_base64: str) -> MultiNodeRunner:
-    name = (launcher or "pdsh").lower()
-    if name not in RUNNER_CLASSES:
-        raise ValueError(f"unknown launcher {launcher!r}; choose from {sorted(RUNNER_CLASSES)}")
-    runner = RUNNER_CLASSES[name](args, world_info_base64)
-    if not runner.backend_exists():
-        logger.warning(f"launcher backend '{name}' not found on PATH")
-    return runner
+    if launcher:
+        name = launcher.lower()
+        if name not in RUNNER_CLASSES:
+            raise ValueError(f"unknown launcher {launcher!r}; choose from {sorted(RUNNER_CLASSES)}")
+        runner = RUNNER_CLASSES[name](args, world_info_base64)
+        if not runner.backend_exists():
+            raise RuntimeError(f"launcher backend '{name}' is not usable on this machine "
+                               "(binary missing from PATH, or gcloud without a TPU name)")
+        return runner
+    for name in _AUTO_DETECT_ORDER:
+        runner = RUNNER_CLASSES[name](args, world_info_base64)
+        if runner.backend_exists():
+            logger.info(f"auto-detected launcher backend: {name}")
+            return runner
+    raise RuntimeError(f"no launcher backend found; install one of {_AUTO_DETECT_ORDER} or pass --launcher")

@@ -180,10 +180,8 @@ def main(args=None):
     world_info = encode_world_info(active_resources)
     from .multinode_runner import select_runner
 
-    launcher = args.launcher
-    if not launcher:
-        launcher = "gcloud" if (args.tpu_name or os.environ.get("TPU_NAME")) else "pdsh"
-    runner = select_runner(launcher, args, world_info)
+    # empty --launcher = auto-detect ladder (gcloud -> pdsh -> slurm -> mpi)
+    runner = select_runner(args.launcher, args, world_info)
     env = os.environ.copy()
     for var in EXPORT_ENVS:
         if var in env:

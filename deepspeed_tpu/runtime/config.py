@@ -332,6 +332,9 @@ class DeepSpeedConfig:
         self.elasticity = ElasticityConfig.from_dict(d.get("elasticity", {}))
         self.compression_config = d.get("compression_training", {})
         self.data_efficiency_config = d.get("data_efficiency", {})
+        # legacy curriculum section (reference constants.py CURRICULUM_LEARNING_LEGACY)
+        self.curriculum_learning_legacy = d.get("curriculum_learning", {})
+        self.random_ltd_config = d.get("random_ltd", {})
 
         self.gradient_clipping = float(d.get("gradient_clipping", 0.0))
         self.prescale_gradients = bool(d.get("prescale_gradients", False))

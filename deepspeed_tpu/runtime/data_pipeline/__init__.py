@@ -1,0 +1,9 @@
+from .curriculum_scheduler import CurriculumScheduler
+from .data_routing.scheduler import RandomLTDScheduler
+from .data_sampling.data_sampler import DeepSpeedDataSampler
+from .data_sampling.indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+__all__ = [
+    "CurriculumScheduler", "RandomLTDScheduler", "DeepSpeedDataSampler", "MMapIndexedDataset",
+    "MMapIndexedDatasetBuilder"
+]

@@ -1,0 +1,114 @@
+"""Curriculum learning difficulty scheduler.
+
+Parity: reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(fixed_discrete :122, fixed_root :130, fixed_linear = root of degree 1,
+custom :113). Difficulty is a plain int (e.g. sequence length) advanced
+as a function of the global step; the engine consumes it to truncate
+batches (a new length means one XLA recompile, so ``difficulty_step``
+also bounds recompilation count — the TPU analogue of the reference's
+tensor-core multiple-of-8 advice).
+"""
+
+import math
+from typing import Callable, Dict, Optional
+
+MIN_DIFFICULTY = "min_difficulty"
+MAX_DIFFICULTY = "max_difficulty"
+CURRENT_DIFFICULTY = "current_difficulty"
+SCHEDULE_TYPE = "schedule_type"
+SCHEDULE_CONFIG = "schedule_config"
+SCHEDULE_FIXED_DISCRETE = "fixed_discrete"
+SCHEDULE_FIXED_LINEAR = "fixed_linear"
+SCHEDULE_FIXED_ROOT = "fixed_root"
+SCHEDULE_CUSTOM = "custom"
+TOTAL_CURRICULUM_STEP = "total_curriculum_step"
+DIFFICULTY_STEP = "difficulty_step"
+ROOT_DEGREE = "root_degree"
+DIFFICULTY = "difficulty"
+MAX_STEP = "max_step"
+
+
+class CurriculumScheduler:
+
+    def __init__(self, config: Dict):
+        for key in (MIN_DIFFICULTY, MAX_DIFFICULTY, SCHEDULE_TYPE):
+            if key not in config:
+                raise ValueError(f"curriculum learning requires config '{key}'")
+        self.state = {
+            MIN_DIFFICULTY: config[MIN_DIFFICULTY],
+            MAX_DIFFICULTY: config[MAX_DIFFICULTY],
+            CURRENT_DIFFICULTY: config[MIN_DIFFICULTY],
+            SCHEDULE_TYPE: config[SCHEDULE_TYPE],
+        }
+        self.first_step = True
+        self.custom_get_difficulty: Optional[Callable[[int], int]] = None
+        stype = config[SCHEDULE_TYPE]
+        sconf = config.get(SCHEDULE_CONFIG, {})
+        if stype == SCHEDULE_FIXED_DISCRETE:
+            if DIFFICULTY not in sconf or MAX_STEP not in sconf:
+                raise ValueError(f"fixed_discrete needs schedule_config with '{DIFFICULTY}' and '{MAX_STEP}'")
+            if len(sconf[DIFFICULTY]) != len(sconf[MAX_STEP]) + 1:
+                raise ValueError("fixed_discrete: len(difficulty) must be len(max_step)+1 "
+                                 "(last difficulty holds for all later steps)")
+            self.state[SCHEDULE_CONFIG] = sconf
+        elif stype in (SCHEDULE_FIXED_LINEAR, SCHEDULE_FIXED_ROOT):
+            required = [TOTAL_CURRICULUM_STEP, DIFFICULTY_STEP] + ([ROOT_DEGREE] if stype == SCHEDULE_FIXED_ROOT
+                                                                   else [])
+            for key in required:
+                if key not in sconf:
+                    raise ValueError(f"{stype} needs schedule_config '{key}'")
+            self.state[SCHEDULE_CONFIG] = sconf
+        elif stype == SCHEDULE_CUSTOM:
+            self.state[SCHEDULE_CONFIG] = sconf
+        else:
+            raise ValueError(f"unsupported curriculum schedule type {stype!r}")
+
+    # -- reference API --
+    def get_current_difficulty(self) -> int:
+        return self.state[CURRENT_DIFFICULTY]
+
+    def set_current_difficulty(self, difficulty: int) -> None:
+        self.state[CURRENT_DIFFICULTY] = difficulty
+
+    def set_custom_get_difficulty(self, schedule_function: Callable[[int], int]) -> None:
+        self.custom_get_difficulty = schedule_function
+
+    def get_state(self) -> Dict:
+        return self.state
+
+    def set_state(self, state: Dict) -> None:
+        self.state = state
+
+    def _fixed_discrete(self, global_steps: int) -> int:
+        sconf = self.state[SCHEDULE_CONFIG]
+        for difficulty, bound in zip(sconf[DIFFICULTY], sconf[MAX_STEP]):
+            if global_steps <= bound:
+                return difficulty
+        return sconf[DIFFICULTY][-1]
+
+    def _fixed_root(self, global_steps: int, root_degree: Optional[int] = None) -> int:
+        sconf = self.state[SCHEDULE_CONFIG]
+        if root_degree is None:
+            root_degree = sconf[ROOT_DEGREE]
+        frac = (float(global_steps) / sconf[TOTAL_CURRICULUM_STEP])**(1.0 / root_degree)
+        next_difficulty = math.floor(frac * (self.state[MAX_DIFFICULTY] - self.state[MIN_DIFFICULTY]) +
+                                     self.state[MIN_DIFFICULTY])
+        next_difficulty -= next_difficulty % sconf[DIFFICULTY_STEP]
+        return min(next_difficulty, self.state[MAX_DIFFICULTY])
+
+    def get_difficulty(self, global_steps: int) -> int:
+        stype = self.state[SCHEDULE_TYPE]
+        if stype == SCHEDULE_FIXED_DISCRETE:
+            return self._fixed_discrete(global_steps)
+        if stype == SCHEDULE_FIXED_LINEAR:
+            return self._fixed_root(global_steps, 1)
+        if stype == SCHEDULE_FIXED_ROOT:
+            return self._fixed_root(global_steps)
+        if self.custom_get_difficulty is None:
+            raise RuntimeError("custom schedule: call set_custom_get_difficulty first")
+        return self.custom_get_difficulty(global_steps)
+
+    def update_difficulty(self, global_steps: int) -> int:
+        if self.state[CURRENT_DIFFICULTY] < self.state[MAX_DIFFICULTY]:
+            self.state[CURRENT_DIFFICULTY] = max(self.get_difficulty(global_steps), self.state[MIN_DIFFICULTY])
+        return self.state[CURRENT_DIFFICULTY]

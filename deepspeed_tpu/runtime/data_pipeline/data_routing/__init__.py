@@ -1,0 +1,4 @@
+from .random_ltd import gather_tokens, random_token_selection, scatter_tokens
+from .scheduler import RandomLTDScheduler
+
+__all__ = ["RandomLTDScheduler", "gather_tokens", "scatter_tokens", "random_token_selection"]

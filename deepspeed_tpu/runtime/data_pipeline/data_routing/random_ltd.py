@@ -1,0 +1,56 @@
+"""Random-LTD token routing ops.
+
+Parity: reference ``csrc/random_ltd/`` (``token_sort.cu`` sorted random
+selection, ``gather_scatter.cu``). On TPU these are XLA-native gathers:
+pick a *sorted* random subset of token positions per batch row (sorted so
+causal masks and RoPE positions stay valid), gather them for the cheap
+layer, and scatter the layer's outputs back over the full sequence. The
+kept length is static under jit; it changes only between steps via the
+scheduler, which re-specializes the compiled step (bounded by the
+schedule's ``difficulty_step``).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_token_selection(rng: jax.Array, batch: int, seq_len: int, keep_len: int) -> jnp.ndarray:
+    """(B, keep_len) sorted position indices, an independent draw per row."""
+    if keep_len > seq_len:
+        raise ValueError(f"keep_len {keep_len} > seq_len {seq_len}")
+    keys = jax.random.uniform(rng, (batch, seq_len))
+    # indices of the keep_len smallest keys = a uniform random subset
+    _, idx = jax.lax.top_k(-keys, keep_len)
+    return jnp.sort(idx, axis=-1)
+
+
+def gather_tokens(x: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """x: (B,S,D), indices: (B,K) -> (B,K,D)."""
+    return jnp.take_along_axis(x, indices[:, :, None], axis=1)
+
+
+def scatter_tokens(full: jnp.ndarray, kept: jnp.ndarray, indices: jnp.ndarray) -> jnp.ndarray:
+    """Write kept (B,K,D) back into full (B,S,D) at the sampled positions;
+    untouched positions keep their pre-layer activations (the residual
+    pass-through the reference implements in gather_scatter.cu)."""
+    b_idx = jnp.arange(full.shape[0])[:, None]
+    return full.at[b_idx, indices].set(kept)
+
+
+def apply_random_ltd(layer_fn, x: jnp.ndarray, rng: jax.Array, keep_len: int,
+                     positions: jnp.ndarray = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run ``layer_fn`` on a random token subset, scatter results back.
+
+    Returns (output (B,S,D), kept position indices). ``layer_fn`` receives
+    (x_kept, positions_kept) so RoPE/causal masking sees true positions.
+    """
+    B, S, _ = x.shape
+    idx = random_token_selection(rng, B, S, keep_len)
+    x_kept = gather_tokens(x, idx)
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S)[None, :], (B, S))
+    pos_kept = jnp.take_along_axis(positions, idx, axis=1)
+    y_kept = layer_fn(x_kept, pos_kept)
+    return scatter_tokens(x, y_kept, idx), idx

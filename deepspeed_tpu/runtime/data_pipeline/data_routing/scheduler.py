@@ -1,0 +1,104 @@
+"""Random layerwise token dropping (random-LTD) scheduler.
+
+Parity: reference ``runtime/data_pipeline/data_routing/scheduler.py``
+(``BaseScheduler`` :15 fixed_linear value, ``RandomLTDScheduler`` :38).
+Schedules the per-layer *kept* sequence length from ``min_value`` up to
+``max_value`` (the full sequence) over ``total_layer_token``-style steps.
+"""
+
+import math
+from typing import Dict
+
+MIN_VALUE = "min_value"
+MAX_VALUE = "max_value"
+CURRENT_VALUE = "current_value"
+SCHEDULE_TYPE = "schedule_type"
+SCHEDULE_CONFIG = "schedule_config"
+TOTAL_CURRICULUM_STEP = "total_curriculum_step"
+DIFFICULTY_STEP = "difficulty_step"
+RANDOM_LTD_LAYER_NUM = "random_ltd_layer_num"
+RANDOM_LTD_LAYER_ID = "random_ltd_layer_id"
+
+
+class BaseScheduler:
+
+    def __init__(self):
+        self.state: Dict = {}
+
+    def _fixed_linear(self, global_steps: int) -> int:
+        sconf = self.state[SCHEDULE_CONFIG]
+        frac = float(global_steps) / sconf[TOTAL_CURRICULUM_STEP]
+        value = math.floor(frac * (self.state[MAX_VALUE] - self.state[MIN_VALUE]) + self.state[MIN_VALUE])
+        value -= value % sconf[DIFFICULTY_STEP]
+        return min(value, self.state[MAX_VALUE])
+
+    def get_value(self, global_steps: int) -> int:
+        if self.state[SCHEDULE_TYPE] == "fixed_linear":
+            return self._fixed_linear(global_steps)
+        raise ValueError(f"unsupported random-ltd schedule {self.state[SCHEDULE_TYPE]!r}")
+
+
+class RandomLTDScheduler(BaseScheduler):
+    """Config (reference ``constants.py`` random_ltd section)::
+
+        {"random_ltd_layer_num": 22, "random_ltd_layer_id": [...],
+         "model_mask_name": ..., "model_type": "decoder",
+         "random_ltd_schedule": {"min_value": 128, "max_value": 2048,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_layer_token": ..., or
+                                "total_curriculum_step": N, "difficulty_step": 8}}}
+    """
+
+    def __init__(self, config: Dict):
+        super().__init__()
+        self.model_layer_num = config.get("random_ltd_layer_num", 0)
+        self.random_ltd_layer_id = config.get("random_ltd_layer_id", list(range(self.model_layer_num)))
+        schedule = config["random_ltd_schedule"]
+        self.state[MIN_VALUE] = schedule[MIN_VALUE]
+        self.state[MAX_VALUE] = schedule[MAX_VALUE]
+        self.state[CURRENT_VALUE] = schedule[MIN_VALUE]
+        self.state[SCHEDULE_TYPE] = schedule.get(SCHEDULE_TYPE, "fixed_linear")
+        self.state[SCHEDULE_CONFIG] = schedule[SCHEDULE_CONFIG]
+        self.state["consumed_layer_tokens"] = 0
+        self.first_step = True
+
+    def get_total_layer_tokens(self, train_iters: int) -> int:
+        """Total tokens processed by the random-ltd layers over a run."""
+        total = 0
+        for step in range(train_iters):
+            total += self.update_seq(step) * len(self.random_ltd_layer_id)
+        return total
+
+    def reset_to_init(self) -> None:
+        self.state[CURRENT_VALUE] = self.state[MIN_VALUE]
+        self.state["consumed_layer_tokens"] = 0
+
+    def get_current_seq(self) -> int:
+        return self.state[CURRENT_VALUE]
+
+    def set_current_seq(self, seq_length: int) -> None:
+        self.state[CURRENT_VALUE] = seq_length
+
+    def get_random_ltd_layer_num(self) -> int:
+        return len(self.random_ltd_layer_id)
+
+    def get_state(self) -> Dict:
+        return self.state
+
+    def set_state(self, state: Dict) -> None:
+        self.state = state
+
+    def update_seq(self, global_steps: int) -> int:
+        if self.state[CURRENT_VALUE] < self.state[MAX_VALUE]:
+            # clamp below: difficulty_step rounding must not undercut min_value
+            self.state[CURRENT_VALUE] = max(self.get_value(global_steps), self.state[MIN_VALUE])
+        self.state["consumed_layer_tokens"] += self.state[CURRENT_VALUE] * len(self.random_ltd_layer_id)
+        return self.state[CURRENT_VALUE]
+
+    def state_dict(self) -> Dict:
+        return {k: self.state[k] for k in (CURRENT_VALUE, MIN_VALUE, MAX_VALUE, "consumed_layer_tokens")}
+
+    def load_state_dict(self, state_dict: Dict) -> None:
+        for k in (CURRENT_VALUE, MIN_VALUE, MAX_VALUE, "consumed_layer_tokens"):
+            if k in state_dict:
+                self.state[k] = state_dict[k]

@@ -1,0 +1,99 @@
+"""Offline data analyzer (map-reduce metric computation).
+
+Parity: reference ``data_sampling/data_analyzer.py`` (880 LoC): shard the
+dataset over workers, each computes per-sample difficulty metrics (map),
+then merge the shards into metric_value / index_to_sample files (reduce)
+that ``DeepSpeedDataSampler`` consumes. The reference's torch-dataloader
+worker pool becomes plain process-count/worker-id sharding; outputs use
+our ``MMapIndexedDataset`` format.
+"""
+
+import os
+from pathlib import Path
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .indexed_dataset import MMapIndexedDataset, MMapIndexedDatasetBuilder
+
+
+def _shard_bounds(n: int, num_workers: int, worker_id: int):
+    per = -(-n // num_workers)
+    return worker_id * per, min((worker_id + 1) * per, n)
+
+
+class DataAnalyzer:
+
+    def __init__(self,
+                 dataset: Sequence,
+                 save_path: str,
+                 metric_names: List[str],
+                 metric_functions: List[Callable],
+                 metric_types: Optional[List[str]] = None,
+                 num_workers: int = 1,
+                 worker_id: int = 0,
+                 batch_size: int = 1,
+                 metric_dtypes: Optional[List] = None):
+        self.dataset = dataset
+        self.save_path = Path(save_path)
+        self.metric_names = metric_names
+        self.metric_functions = metric_functions
+        self.metric_types = metric_types or ["single_value_per_sample"] * len(metric_names)
+        self.metric_dtypes = metric_dtypes or [np.int64] * len(metric_names)
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.batch_size = batch_size
+
+    def _worker_file(self, metric: str, worker_id: int) -> Path:
+        return self.save_path / metric / f"worker{worker_id}_metric_value"
+
+    # ------------------------------------------------------------------
+    def run_map(self) -> None:
+        """Compute this worker's shard of every metric and write it out."""
+        start, end = _shard_bounds(len(self.dataset), self.num_workers, self.worker_id)
+        builders = {}
+        for name, dtype in zip(self.metric_names, self.metric_dtypes):
+            out = self._worker_file(name, self.worker_id)
+            out.parent.mkdir(parents=True, exist_ok=True)
+            builders[name] = MMapIndexedDatasetBuilder(out, dtype=dtype)
+        for i0 in range(start, end, self.batch_size):
+            batch = [self.dataset[i] for i in range(i0, min(i0 + self.batch_size, end))]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                values = fn(batch)
+                for v in np.atleast_1d(np.asarray(values)):
+                    builders[name].add_item(np.atleast_1d(v))
+        for b in builders.values():
+            b.finalize()
+
+    def run_reduce(self) -> None:
+        """Merge all workers' shards: <metric>/metric_value (one record per
+        sample, dataset order) + <metric>/index_to_sample_percentile_merged
+        (sample ids sorted by metric, for percentile clustering)."""
+        for name, dtype in zip(self.metric_names, self.metric_dtypes):
+            merged = MMapIndexedDatasetBuilder(self.save_path / name / "metric_value", dtype=dtype)
+            all_values = []
+            for w in range(self.num_workers):
+                shard = MMapIndexedDataset(self._worker_file(name, w))
+                for i in range(len(shard)):
+                    rec = shard[i]
+                    merged.add_item(rec)
+                    all_values.append(rec[0])
+            merged.finalize()
+            order = np.argsort(np.asarray(all_values), kind="stable")
+            idx_builder = MMapIndexedDatasetBuilder(self.save_path / name / "index_to_sample_percentile_merged",
+                                                    dtype=np.int64)
+            for sample_id in order:
+                idx_builder.add_item(np.asarray([sample_id]))
+            idx_builder.finalize()
+
+    def run_map_reduce(self) -> None:
+        if self.num_workers > 1:
+            # multi-worker runs call run_map per worker then reduce once
+            raise RuntimeError("run_map_reduce is single-worker; call run_map on each worker, then run_reduce")
+        self.run_map()
+        self.run_reduce()
+
+    @staticmethod
+    def load_metric(save_path: str, metric: str) -> np.ndarray:
+        ds = MMapIndexedDataset(Path(save_path) / metric / "metric_value")
+        return np.array([ds[i][0] for i in range(len(ds))])

@@ -1,0 +1,167 @@
+"""Curriculum-aware difficulty-based data sampler.
+
+Parity: reference ``data_sampling/data_sampler.py:36``
+(``DeepSpeedDataSampler``): each global step, draw the step's sample
+indices only from the pool of samples whose difficulty metric is within
+the curriculum's current bound; the pool ("cluster") grows as difficulty
+rises, and samples within a cluster are shuffled deterministically.
+
+Differences from the reference: metrics live as in-memory numpy arrays or
+``MMapIndexedDataset`` paths (same formats the DataAnalyzer writes); the
+multi-rank cluster-file dance (rank-0 writes cluster indices to disk,
+broadcast via file system) collapses to pure in-process numpy — under
+SPMD there is one sampler per host feeding the whole mesh.
+"""
+
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..curriculum_scheduler import CurriculumScheduler
+from .indexed_dataset import MMapIndexedDataset, find_fit_int_dtype
+
+CURRICULUM_LEARNING_VALUE_BASED = "values"
+CURRICULUM_LEARNING_PERCENTILE_BASED = "percentile"
+CURRICULUM_LEARNING_SINGLE_CLUSTER = "single_cluster"
+CURRICULUM_LEARNING_SCHEDULE_BASED = "schedule_based"
+
+
+class DeepSpeedDataSampler:
+
+    def __init__(self,
+                 data_efficiency_config: Dict,
+                 one_epoch_total_samples: int,
+                 micro_batch_size: int,
+                 data_parallel_rank: int,
+                 data_parallel_size: int,
+                 data_parallel_group=None,
+                 gradient_accumulation_steps: int = 1,
+                 global_rank: int = 0,
+                 drop_last: bool = True,
+                 metric_values: Optional[Dict[str, np.ndarray]] = None):
+        ds_cfg = data_efficiency_config.get("data_sampling", {})
+        self.num_epochs = ds_cfg.get("num_epochs", 1)
+        self.one_epoch_total_samples = one_epoch_total_samples
+        self.total_samples = one_epoch_total_samples * self.num_epochs
+        self.micro_batch_size = micro_batch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.gradient_accumulation_steps = gradient_accumulation_steps
+        self.micro_batch_times_data_parallel_size = micro_batch_size * data_parallel_size
+        self.global_batch_size = self.micro_batch_times_data_parallel_size * gradient_accumulation_steps
+        self.drop_last = drop_last
+        self.np_rng = np.random.default_rng(data_efficiency_config.get("seed", 1234))
+        self.consumed_samples = 0
+        self.curriculum_step = 0
+
+        cl_cfg = ds_cfg.get("curriculum_learning", {})
+        self.curriculum_enabled = cl_cfg.get("enabled", False)
+        self.curriculum_schedulers: Dict[str, CurriculumScheduler] = {}
+        self.difficulty_type: Dict[str, str] = {}
+        self.clustering_type: Dict[str, str] = {}
+        self._metric_values: Dict[str, np.ndarray] = {}
+        if self.curriculum_enabled:
+            for metric, mconf in cl_cfg.get("curriculum_metrics", {}).items():
+                self.curriculum_schedulers[metric] = CurriculumScheduler(mconf)
+                self.difficulty_type[metric] = mconf.get("difficulty_type", CURRICULUM_LEARNING_VALUE_BASED)
+                self.clustering_type[metric] = mconf.get("clustering_type", CURRICULUM_LEARNING_SINGLE_CLUSTER)
+                if self.clustering_type[metric] != CURRICULUM_LEARNING_SINGLE_CLUSTER:
+                    if metric_values and metric in metric_values:
+                        vals = np.asarray(metric_values[metric])
+                    elif "data_path" in mconf or "metric_path" in mconf:
+                        path = mconf.get("metric_path") or mconf["data_path"]
+                        ds = MMapIndexedDataset(path)
+                        vals = np.array([ds[i][0] for i in range(len(ds))])
+                    else:
+                        raise ValueError(f"curriculum metric {metric!r}: need metric_values or metric_path")
+                    if len(vals) != one_epoch_total_samples:
+                        raise ValueError(f"metric {metric!r} covers {len(vals)} samples, dataset has "
+                                         f"{one_epoch_total_samples}")
+                    self._metric_values[metric] = vals
+
+        assert self.total_samples > 0 and self.micro_batch_size > 0
+        assert self.data_parallel_rank < data_parallel_size
+
+        self.index_dtype = find_fit_int_dtype(0, one_epoch_total_samples)
+        # per-epoch base permutation; curriculum filters on top of it
+        self._epoch_perm = self.np_rng.permutation(one_epoch_total_samples).astype(self.index_dtype)
+
+    def __len__(self) -> int:
+        return self.total_samples
+
+    def set_custom_curriculum_learning_schedule(self, schedule_func_dict: Dict) -> None:
+        for metric, fn in schedule_func_dict.items():
+            self.curriculum_schedulers[metric].set_custom_get_difficulty(fn)
+
+    # ------------------------------------------------------------------
+    def _eligible_pool(self) -> np.ndarray:
+        """Sample indices currently admitted by every curriculum metric."""
+        mask = np.ones(self.one_epoch_total_samples, dtype=bool)
+        for metric, sched in self.curriculum_schedulers.items():
+            difficulty = sched.get_current_difficulty()
+            if self.clustering_type[metric] == CURRICULUM_LEARNING_SINGLE_CLUSTER:
+                continue  # schedule drives something else (e.g. seqlen truncation)
+            vals = self._metric_values[metric]
+            if self.difficulty_type[metric] == CURRICULUM_LEARNING_VALUE_BASED:
+                mask &= vals <= difficulty
+            else:  # percentile-based: difficulty is a percentile in [0,100]
+                bound = np.percentile(vals, min(difficulty, 100))
+                mask &= vals <= bound
+        pool = self._epoch_perm[mask[self._epoch_perm]]
+        if len(pool) == 0:
+            # always admit the easiest samples so training can proceed
+            easiest = min(self._metric_values, key=lambda m: self._metric_values[m].min())
+            order = np.argsort(self._metric_values[easiest])
+            pool = order[:self.global_batch_size].astype(self.index_dtype)
+        return pool
+
+    def _advance_curriculum(self) -> None:
+        self.curriculum_step += 1
+        for sched in self.curriculum_schedulers.values():
+            sched.update_difficulty(self.curriculum_step)
+
+    def get_start_end_idx(self, batch_len: Optional[int] = None):
+        """This DP rank's slice bounds within a global micro-batch."""
+        n = batch_len if batch_len is not None else self.micro_batch_times_data_parallel_size
+        per_rank = n // self.data_parallel_size
+        start = self.data_parallel_rank * per_rank
+        return start, start + per_rank
+
+    def __iter__(self) -> Iterator[List[int]]:
+        while self.consumed_samples < self.total_samples:
+            if self.curriculum_enabled:
+                self._advance_curriculum()
+                pool = self._eligible_pool()
+            else:
+                pool = self._epoch_perm
+            take = self.global_batch_size
+            if len(pool) < take:
+                if self.drop_last and not self.curriculum_enabled:
+                    return
+                reps = -(-take // len(pool))
+                pool = np.tile(pool, reps)
+            chosen = self.np_rng.choice(pool, size=take, replace=False) if len(pool) >= take else pool[:take]
+            self.consumed_samples += take
+            for micro in np.array_split(chosen, self.gradient_accumulation_steps):
+                start, end = self.get_start_end_idx(len(micro))
+                yield [int(i) for i in micro[start:end]]
+
+    def state_dict(self) -> Dict:
+        import copy
+
+        return {
+            "consumed_samples": self.consumed_samples,
+            "curriculum_step": self.curriculum_step,
+            "np_rng_state": self.np_rng.bit_generator.state,
+            # deep-copied: schedulers mutate their state dicts in place, and a
+            # snapshot must not track training past the snapshot point
+            "curriculum_states": {m: copy.deepcopy(s.get_state()) for m, s in self.curriculum_schedulers.items()},
+        }
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.consumed_samples = sd["consumed_samples"]
+        self.curriculum_step = sd["curriculum_step"]
+        self.np_rng.bit_generator.state = sd["np_rng_state"]
+        for m, state in sd.get("curriculum_states", {}).items():
+            if m in self.curriculum_schedulers:
+                self.curriculum_schedulers[m].set_state(state)

@@ -1,0 +1,100 @@
+"""Memory-mapped indexed dataset.
+
+Parity: reference ``data_sampling/indexed_dataset.py`` (627 LoC,
+Megatron-derived ``MMapIndexedDataset``). Same capability — O(1) random
+access to variable-length numpy records via an mmap'd data file plus an
+index of sizes/offsets — with a simpler self-describing layout:
+
+``<path>.idx``: magic | version | dtype code | count | sizes[count] (int64)
+``<path>.bin``: records back-to-back, native byte order
+"""
+
+import struct
+from pathlib import Path
+from typing import List, Union
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+
+_DTYPES = {
+    1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+    6: np.float32, 7: np.float64, 8: np.uint16, 9: np.uint32,
+}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def find_fit_int_dtype(min_value: int, max_value: int):
+    """Smallest integer dtype covering [min_value, max_value] (reference
+    ``data_sampling/utils.py``)."""
+    for dt in (np.uint8, np.int8, np.int16, np.uint16, np.int32, np.uint32, np.int64):
+        info = np.iinfo(dt)
+        if info.min <= min_value and max_value <= info.max:
+            return dt
+    return np.int64
+
+
+class MMapIndexedDatasetBuilder:
+
+    def __init__(self, out_file: Union[str, Path], dtype=np.int32):
+        self._path = Path(str(out_file))
+        self._dtype = np.dtype(dtype)
+        if self._dtype not in _DTYPE_CODES:
+            raise ValueError(f"unsupported dtype {dtype}")
+        self._bin = open(self._path.with_suffix(".bin"), "wb")
+        self._sizes: List[int] = []
+
+    def add_item(self, array) -> None:
+        arr = np.asarray(array, dtype=self._dtype)
+        self._bin.write(arr.tobytes(order="C"))
+        self._sizes.append(arr.size)
+
+    def finalize(self, index_file: Union[str, Path, None] = None) -> None:
+        self._bin.close()
+        idx_path = Path(str(index_file)) if index_file else self._path.with_suffix(".idx")
+        with open(idx_path, "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<QQQ", _VERSION, _DTYPE_CODES[self._dtype], len(self._sizes)))
+            f.write(np.asarray(self._sizes, dtype=np.int64).tobytes())
+
+
+class MMapIndexedDataset:
+
+    def __init__(self, path: Union[str, Path], skip_warmup: bool = True):
+        base = Path(str(path))
+        idx_path = base if base.suffix == ".idx" else base.with_suffix(".idx")
+        bin_path = idx_path.with_suffix(".bin")
+        with open(idx_path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(f"{idx_path}: not a deepspeed_tpu indexed dataset (magic {magic!r})")
+            version, dtype_code, count = struct.unpack("<QQQ", f.read(24))
+            if version != _VERSION:
+                raise ValueError(f"{idx_path}: unsupported version {version}")
+            self._dtype = np.dtype(_DTYPES[int(dtype_code)])
+            self._sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
+        self._offsets = np.zeros(count + 1, dtype=np.int64)
+        np.cumsum(self._sizes, out=self._offsets[1:])
+        self._data = np.memmap(bin_path, dtype=self._dtype, mode="r")
+
+    def __len__(self) -> int:
+        return len(self._sizes)
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self._sizes
+
+    def __getitem__(self, i):
+        if isinstance(i, (int, np.integer)):
+            if not -len(self) <= i < len(self):
+                raise IndexError(f"index {i} out of range for {len(self)} records")
+            i = int(i) % len(self)
+            return np.array(self._data[self._offsets[i]:self._offsets[i + 1]])
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        raise TypeError(f"index must be int or slice, got {type(i)}")
+
+    def get(self, i, offset: int = 0, length: int = None):
+        row = self[i]
+        return row[offset:offset + length if length is not None else None]

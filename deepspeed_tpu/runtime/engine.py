@@ -46,6 +46,7 @@ from .zero.partition import (batch_specs, plan_grad_specs, plan_opt_state_specs,
 MODEL_STATES_FILENAME = "model_states.msgpack"
 OPTIM_STATES_FILENAME = "optim_states.msgpack"
 CLIENT_STATE_FILENAME = "client_state.msgpack"
+CURRICULUM_STATE_FILENAME = "curriculum_state.msgpack"
 LATEST_FILENAME = "latest"
 
 
@@ -189,6 +190,28 @@ class DeepSpeedEngine:
         self.monitor = self._configure_monitor()
         self.flops_profiler = None  # built lazily at the configured profile step
 
+        # legacy curriculum learning (reference engine.py:1821-1833): the
+        # scheduler's difficulty is a sequence length; forward() truncates
+        # batches to it (each new length = one XLA re-specialization,
+        # bounded by schedule_config.difficulty_step)
+        self.curriculum_scheduler = None
+        cl = self.config.curriculum_learning_legacy
+        if cl.get("enabled", False):
+            from .data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+            self.curriculum_scheduler = CurriculumScheduler(cl)
+            self._curriculum_type = cl.get("curriculum_type", "seqlen")
+
+        # random-LTD (reference engine.py:344-348): the engine owns the
+        # kept-seq-length scheduler; models apply the token routing via
+        # data_pipeline.data_routing.apply_random_ltd
+        self.random_ltd_scheduler = None
+        rltd = self.config.random_ltd_config
+        if rltd.get("enabled", False):
+            from .data_pipeline.data_routing.scheduler import RandomLTDScheduler
+
+            self.random_ltd_scheduler = RandomLTDScheduler(rltd)
+
         # --- training data ---
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
@@ -278,8 +301,24 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # train loop API (reference engine.py:1787,1926,2125)
     # ------------------------------------------------------------------
+    def curriculum_difficulty(self) -> int:
+        assert self.curriculum_scheduler is not None, "curriculum_learning is not enabled"
+        return self.curriculum_scheduler.get_current_difficulty()
+
+    def _apply_curriculum(self, batch):
+        seqlen = self.curriculum_scheduler.update_difficulty(self.global_steps + 1)
+        if self._curriculum_type != "seqlen" or not isinstance(batch, dict):
+            return batch
+        out = dict(batch)
+        for key in ("input_ids", "labels", "attention_mask", "position_ids", "segment_ids"):
+            if key in out and getattr(out[key], "ndim", 0) >= 2 and out[key].shape[1] > seqlen:
+                out[key] = out[key][:, :seqlen]
+        return out
+
     def forward(self, batch):
         self.timers(FORWARD_GLOBAL_TIMER).start()
+        if self.curriculum_scheduler is not None:
+            batch = self._apply_curriculum(batch)
         batch = self._put_batch(batch)
         rng = jax.random.fold_in(self._rng, self.micro_steps)
         scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
@@ -343,6 +382,8 @@ class DeepSpeedEngine:
             log_dist(f"step {self.global_steps}: grad overflow — step skipped, "
                      f"loss scale -> {self.loss_scaler.loss_scale}", ranks=[0])
         self.global_steps += 1
+        if self.random_ltd_scheduler is not None:
+            self.random_ltd_scheduler.update_seq(self.global_steps)
         self.timers(STEP_GLOBAL_TIMER).stop()
         if self.global_steps % self.config.steps_per_print == 0:
             self._report(lr)
@@ -481,6 +522,10 @@ class DeepSpeedEngine:
             "skipped_steps": self.skipped_steps,
         }
         self.checkpoint_engine.save(optim_state, os.path.join(d, OPTIM_STATES_FILENAME))
+        if self.curriculum_scheduler is not None:
+            # own file: plain-python state, no array template needed on load
+            self.checkpoint_engine.save(self.curriculum_scheduler.get_state(),
+                                        os.path.join(d, CURRICULUM_STATE_FILENAME))
         if client_state:
             self.checkpoint_engine.save(client_state, os.path.join(d, CLIENT_STATE_FILENAME))
         if save_latest and jax.process_index() == 0:
@@ -530,6 +575,9 @@ class DeepSpeedEngine:
                 self.micro_steps = int(state["micro_steps"])
                 self.global_samples = int(state["global_samples"])
                 self.skipped_steps = int(state["skipped_steps"])
+            curriculum_path = os.path.join(d, CURRICULUM_STATE_FILENAME)
+            if self.curriculum_scheduler is not None and os.path.exists(curriculum_path):
+                self.curriculum_scheduler.set_state(self.checkpoint_engine.load(curriculum_path))
             cs_path = os.path.join(d, CLIENT_STATE_FILENAME)
             if os.path.exists(cs_path):
                 client_state = self.checkpoint_engine.load(cs_path)

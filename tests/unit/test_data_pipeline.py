@@ -1,0 +1,271 @@
+"""Data-efficiency pipeline tests.
+
+Mirrors reference ``tests/unit/runtime/test_data_efficiency.py``:
+curriculum schedule math, engine seqlen-truncation integration,
+sampler eligibility under a rising difficulty bound, indexed dataset
+round-trip, analyzer map-reduce, random-LTD token routing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline import (CurriculumScheduler, DeepSpeedDataSampler, MMapIndexedDataset,
+                                                 MMapIndexedDatasetBuilder, RandomLTDScheduler)
+from deepspeed_tpu.runtime.data_pipeline.data_routing.random_ltd import (apply_random_ltd, gather_tokens,
+                                                                         random_token_selection, scatter_tokens)
+from deepspeed_tpu.runtime.data_pipeline.data_sampling.data_analyzer import DataAnalyzer
+
+
+# -------------------- curriculum scheduler --------------------
+def test_fixed_linear_schedule():
+    sched = CurriculumScheduler({
+        "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 64,
+        "schedule_type": "fixed_linear",
+        "schedule_config": {"total_curriculum_step": 10, "difficulty_step": 8},
+    })
+    assert sched.get_current_difficulty() == 8
+    values = [sched.update_difficulty(s) for s in range(1, 13)]
+    assert values[-1] == 64  # reaches max
+    assert all(v % 8 == 0 for v in values)
+    assert values == sorted(values)  # monotone
+
+
+def test_fixed_root_slower_than_linear_early():
+    mk = lambda stype, extra: CurriculumScheduler({
+        "min_difficulty": 0, "max_difficulty": 100, "schedule_type": stype,
+        "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 1, **extra}})
+    lin, root = mk("fixed_linear", {}), mk("fixed_root", {"root_degree": 2})
+    assert root.get_difficulty(25) > lin.get_difficulty(25)  # sqrt grows fast early
+
+
+def test_fixed_discrete_schedule():
+    sched = CurriculumScheduler({
+        "min_difficulty": 1, "max_difficulty": 3, "schedule_type": "fixed_discrete",
+        "schedule_config": {"difficulty": [1, 2, 3], "max_step": [5, 10]},
+    })
+    assert sched.get_difficulty(3) == 1
+    assert sched.get_difficulty(7) == 2
+    assert sched.get_difficulty(11) == 3
+    with pytest.raises(ValueError):
+        CurriculumScheduler({"min_difficulty": 1, "max_difficulty": 3, "schedule_type": "fixed_discrete",
+                             "schedule_config": {"difficulty": [1, 2], "max_step": [5, 10]}})
+
+
+def test_custom_schedule_and_state_roundtrip():
+    sched = CurriculumScheduler({"min_difficulty": 2, "max_difficulty": 10, "schedule_type": "custom"})
+    sched.set_custom_get_difficulty(lambda step: min(2 + step, 10))
+    assert sched.update_difficulty(3) == 5
+    state = dict(sched.get_state())
+    sched2 = CurriculumScheduler({"min_difficulty": 2, "max_difficulty": 10, "schedule_type": "custom"})
+    sched2.set_state(state)
+    assert sched2.get_current_difficulty() == 5
+
+
+# -------------------- random-LTD --------------------
+def test_random_ltd_scheduler():
+    sched = RandomLTDScheduler({
+        "random_ltd_layer_num": 4, "random_ltd_layer_id": [1, 2],
+        "random_ltd_schedule": {"min_value": 16, "max_value": 128, "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 8, "difficulty_step": 16}},
+    })
+    assert sched.get_current_seq() == 16
+    seqs = [sched.update_seq(s) for s in range(1, 10)]
+    assert seqs[-1] == 128
+    assert sched.get_random_ltd_layer_num() == 2
+    sd = sched.state_dict()
+    sched.reset_to_init()
+    assert sched.get_current_seq() == 16
+    sched.load_state_dict(sd)
+    assert sched.get_current_seq() == 128
+
+
+def test_random_ltd_min_value_clamp():
+    sched = RandomLTDScheduler({
+        "random_ltd_layer_id": [0],
+        "random_ltd_schedule": {"min_value": 100, "max_value": 2048, "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 1000, "difficulty_step": 16}},
+    })
+    # step rounding (100 -> 96) must not undercut the configured floor
+    assert sched.update_seq(0) == 100
+
+
+def test_analyzer_map_reduce_rejects_multiworker(tmp_path):
+    an = DataAnalyzer([1, 2], str(tmp_path), ["m"], [lambda b: b], num_workers=2, worker_id=1)
+    with pytest.raises(RuntimeError):
+        an.run_map_reduce()
+
+
+def test_sampler_state_snapshot_is_immutable():
+    vals = np.arange(1, 33)
+    s = _sampler(vals, (4, 32, 4))
+    it = iter(s)
+    next(it)
+    sd = s.state_dict()
+    snap = dict(sd["curriculum_states"]["seqlen"])
+    for _ in range(5):
+        next(it)
+    assert sd["curriculum_states"]["seqlen"] == snap  # snapshot didn't track live state
+
+
+def test_random_token_selection_sorted_unique():
+    idx = random_token_selection(jax.random.PRNGKey(0), batch=4, seq_len=32, keep_len=8)
+    assert idx.shape == (4, 8)
+    for row in np.asarray(idx):
+        assert len(set(row.tolist())) == 8
+        assert list(row) == sorted(row)
+        assert row.min() >= 0 and row.max() < 32
+
+
+def test_gather_scatter_roundtrip():
+    x = jnp.arange(2 * 8 * 3, dtype=jnp.float32).reshape(2, 8, 3)
+    idx = random_token_selection(jax.random.PRNGKey(1), 2, 8, 4)
+    kept = gather_tokens(x, idx)
+    out = scatter_tokens(x, kept * 0 + 99.0, idx)
+    out_np, idx_np = np.asarray(out), np.asarray(idx)
+    for b in range(2):
+        for s in range(8):
+            expected = 99.0 if s in idx_np[b] else np.asarray(x)[b, s, 0]
+            assert out_np[b, s, 0] == expected
+
+
+def test_apply_random_ltd_passthrough_identity():
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 16, 4))
+    out, idx = apply_random_ltd(lambda xk, pos: xk, x, jax.random.PRNGKey(3), keep_len=6)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x), rtol=1e-6)
+
+
+# -------------------- indexed dataset + analyzer --------------------
+def test_indexed_dataset_roundtrip(tmp_path):
+    path = tmp_path / "ds"
+    builder = MMapIndexedDatasetBuilder(path, dtype=np.int32)
+    rows = [np.arange(n, dtype=np.int32) for n in (3, 1, 7, 5)]
+    for r in rows:
+        builder.add_item(r)
+    builder.finalize()
+    ds = MMapIndexedDataset(path)
+    assert len(ds) == 4
+    for got, want in zip((ds[i] for i in range(4)), rows):
+        np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(ds.sizes, [3, 1, 7, 5])
+    with pytest.raises(IndexError):
+        ds[4]
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    dataset = [{"input_ids": np.arange(n)} for n in [5, 3, 9, 1, 7, 2]]
+
+    def seqlen_metric(batch):
+        return [len(s["input_ids"]) for s in batch]
+
+    an = DataAnalyzer(dataset, str(tmp_path), ["seqlen"], [seqlen_metric], batch_size=2)
+    an.run_map_reduce()
+    vals = DataAnalyzer.load_metric(str(tmp_path), "seqlen")
+    np.testing.assert_array_equal(vals, [5, 3, 9, 1, 7, 2])
+    order = MMapIndexedDataset(tmp_path / "seqlen" / "index_to_sample_percentile_merged")
+    sorted_ids = [int(order[i][0]) for i in range(len(order))]
+    assert sorted_ids == [3, 5, 1, 0, 4, 2]  # by ascending seqlen
+
+
+def test_data_analyzer_multi_worker(tmp_path):
+    dataset = list(range(10))
+    metric = lambda batch: [x * 2 for x in batch]
+    for w in range(2):
+        DataAnalyzer(dataset, str(tmp_path), ["double"], [metric], num_workers=2, worker_id=w).run_map()
+    DataAnalyzer(dataset, str(tmp_path), ["double"], [metric], num_workers=2, worker_id=0).run_reduce()
+    np.testing.assert_array_equal(DataAnalyzer.load_metric(str(tmp_path), "double"), np.arange(10) * 2)
+
+
+# -------------------- data sampler --------------------
+def _sampler(metric_vals, difficulty_cfg, micro=2, dp=2, gas=1):
+    cfg = {
+        "seed": 7,
+        "data_sampling": {
+            "num_epochs": 2,
+            "curriculum_learning": {
+                "enabled": True,
+                "curriculum_metrics": {
+                    "seqlen": {
+                        "min_difficulty": difficulty_cfg[0], "max_difficulty": difficulty_cfg[1],
+                        "schedule_type": "fixed_linear",
+                        "schedule_config": {"total_curriculum_step": difficulty_cfg[2], "difficulty_step": 1},
+                        "difficulty_type": "values", "clustering_type": "schedule_based",
+                    }
+                },
+            },
+        },
+    }
+    return DeepSpeedDataSampler(cfg, one_epoch_total_samples=len(metric_vals), micro_batch_size=micro,
+                                data_parallel_rank=0, data_parallel_size=dp, gradient_accumulation_steps=gas,
+                                metric_values={"seqlen": np.asarray(metric_vals)})
+
+
+def test_sampler_respects_difficulty_bound():
+    vals = np.array([1, 2, 3, 4, 5, 6, 7, 8] * 4)
+    sampler = _sampler(vals, (2, 8, 8), micro=2, dp=2)
+    it = iter(sampler)
+    first = next(it)
+    assert len(first) == 2  # this rank's share of the global micro batch
+    # early steps: only low-difficulty samples eligible
+    assert all(vals[i] <= 3 for i in first)
+    hardest_seen = 0
+    for batch in it:
+        hardest_seen = max(hardest_seen, max(vals[i] for i in batch))
+    assert hardest_seen == 8  # curriculum eventually admits everything
+
+
+def test_sampler_state_roundtrip():
+    vals = np.arange(1, 33)
+    s1 = _sampler(vals, (4, 32, 4))
+    it = iter(s1)
+    for _ in range(3):
+        next(it)
+    sd = s1.state_dict()
+    s2 = _sampler(vals, (4, 32, 4))
+    s2.load_state_dict(sd)
+    assert s2.consumed_samples == s1.consumed_samples
+    assert s2.curriculum_step == s1.curriculum_step
+
+
+def test_sampler_len_and_no_curriculum():
+    cfg = {"data_sampling": {"num_epochs": 3}}
+    sampler = DeepSpeedDataSampler(cfg, one_epoch_total_samples=8, micro_batch_size=2, data_parallel_rank=1,
+                                   data_parallel_size=2)
+    assert len(sampler) == 24
+    batch = next(iter(sampler))
+    assert len(batch) == 2 and all(0 <= i < 8 for i in batch)
+
+
+# -------------------- engine integration --------------------
+def test_engine_curriculum_seqlen(tmp_path):
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 100,
+        "curriculum_learning": {
+            "enabled": True, "curriculum_type": "seqlen", "min_difficulty": 8, "max_difficulty": 16,
+            "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 3, "difficulty_step": 8},
+        },
+    }
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    rng = np.random.RandomState(0)
+    data = [{"input_ids": rng.randint(0, 1024, size=(16,)).astype(np.int32)} for _ in range(8)]
+    it = RepeatingLoader(engine.deepspeed_io(data))
+    losses = [float(engine.train_batch(it)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert engine.curriculum_difficulty() == 16  # ramped to max
+    # resume round-trip keeps the difficulty
+    engine.save_checkpoint(str(tmp_path))
+    params2 = model.init(jax.random.PRNGKey(1), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine2, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params2, config=cfg)
+    engine2.load_checkpoint(str(tmp_path))
+    assert engine2.curriculum_difficulty() == 16

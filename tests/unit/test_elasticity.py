@@ -88,6 +88,16 @@ def test_version_02_chip_count_units():
     assert 8 in valid
 
 
+def test_version_02_microbatch_accounts_for_mp():
+    cfg = json.loads(json.dumps(BASE))
+    cfg["elasticity"].update({"version": 0.2, "num_gpus_per_node": 8, "model_parallel_size": 2,
+                              "micro_batch_sizes": [6], "max_train_batch_size": 24})
+    batch, _, micro = compute_elastic_config(cfg, world_size=8, return_microbatch=True)
+    # dp replicas = 8/2 = 4; batch per replica = batch/4 must admit micro=6
+    assert micro == 6
+    assert batch % (6 * 4) == 0
+
+
 def test_hcn_table_matches_sieve():
     from deepspeed_tpu.elasticity.elasticity import _HCN_TABLE, _sieve_highly_composite
 

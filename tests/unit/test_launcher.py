@@ -90,10 +90,14 @@ def test_resolve_node_rank_from_scheduler_env(monkeypatch):
 
 
 def test_runner_commands(tmp_path):
+    from deepspeed_tpu.launcher.multinode_runner import RUNNER_CLASSES
+
     hostfile = _hostfile(tmp_path, "w0 slots=4\nw1 slots=4\n")
     args = parse_args(["-H", hostfile, "--master_addr", "w0", "train.py", "--lr", "0.1"])
     active = {"w0": [0, 1, 2, 3], "w1": [0, 1, 2, 3]}
     world = encode_world_info(active)
+    # construct runners directly: this container has none of the backends
+    select_runner = lambda name, a, w: RUNNER_CLASSES[name](a, w)
 
     pdsh = select_runner("pdsh", args, world).get_cmd({}, active)
     assert pdsh[0] == "pdsh" and "w0,w1" in pdsh
@@ -116,8 +120,15 @@ def test_runner_commands(tmp_path):
     assert gcloud[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh"]
     assert "--worker=all" in gcloud
 
+    from deepspeed_tpu.launcher.multinode_runner import select_runner as real_select_runner
+
     with pytest.raises(ValueError):
-        select_runner("bogus", args, world)
+        real_select_runner("bogus", args, world)
+    # explicitly requested but unusable backend fails loudly, not in Popen
+    args2 = parse_args(["-H", hostfile, "train.py"])
+    args2.tpu_name = ""
+    with pytest.raises(RuntimeError):
+        real_select_runner("gcloud", args2, world)
 
 
 def test_env_report_smoke():
