@@ -1,0 +1,10 @@
+from .compress import CompressionEngine, init_compression, redundancy_clean, student_initialization
+from .ops import (channel_pruning_mask, fake_quantize, head_pruning_mask, magnitude_mask, quantize_activation,
+                  row_pruning_mask)
+from .scheduler import CompressionScheduler
+
+__all__ = [
+    "CompressionEngine", "init_compression", "redundancy_clean", "student_initialization", "fake_quantize",
+    "magnitude_mask", "row_pruning_mask", "head_pruning_mask", "channel_pruning_mask", "quantize_activation",
+    "CompressionScheduler"
+]

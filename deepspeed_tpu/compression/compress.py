@@ -1,0 +1,214 @@
+"""Compression engine: config-driven QAT + pruning over a params pytree.
+
+Parity: reference ``compression/compress.py`` (``init_compression`` :100,
+``redundancy_clean`` :148, ``student_initialization`` :192). The reference
+swaps ``nn.Linear`` for ``LinearLayer_Compress`` modules that re-quantize
+and re-mask their weights every forward; the functional equivalent is a
+pure transform ``apply(params, state)`` inserted inside the differentiated
+loss — masks and quantization ranges are recomputed in-graph from the
+live weights, and the straight-through estimator carries gradients to the
+raw parameters. Activation flags and bit widths enter as traced scalars,
+so a technique switching on (or bits annealing down) does NOT trigger an
+XLA recompile.
+
+Group config format follows the reference: each technique has
+``shared_parameters`` (enabled, schedule_offset, ...) and
+``different_groups`` of {params, modules: [name patterns]}.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import logger
+from .ops import channel_pruning_mask, fake_quantize, head_pruning_mask, magnitude_mask, row_pruning_mask
+from .scheduler import CompressionScheduler
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+ACTIVATION_QUANTIZATION = "activation_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+ROW_PRUNING = "row_pruning"
+HEAD_PRUNING = "head_pruning"
+CHANNEL_PRUNING = "channel_pruning"
+LAYER_REDUCTION = "layer_reduction"
+_PRUNE_TECHNIQUES = (SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING)
+
+
+def _path_str(path: Tuple) -> str:
+    parts = []
+    for p in path:
+        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
+    return "/".join(parts)
+
+
+def _match(path: str, patterns: List[str]) -> bool:
+    return any(pat == "*" or pat in path for pat in patterns)
+
+
+class CompressionEngine:
+
+    def __init__(self, params, compression_config: Dict, num_heads: Optional[int] = None):
+        self.config = compression_config or {}
+        self.num_heads = num_heads
+        shared = {t: dict(self.config.get(t, {}).get("shared_parameters", {}))
+                  for t in (WEIGHT_QUANTIZATION, ACTIVATION_QUANTIZATION) + _PRUNE_TECHNIQUES}
+        # fold the first group's params into shared for bit-annealing lookups
+        wq_groups = self.config.get(WEIGHT_QUANTIZATION, {}).get("different_groups", {})
+        if wq_groups:
+            first = next(iter(wq_groups.values())).get("params", {})
+            for key in ("start_bits", "target_bits", "quantization_period"):
+                if key in first and first[key] is not None:
+                    shared[WEIGHT_QUANTIZATION].setdefault(key, first[key])
+        self.scheduler = CompressionScheduler(shared)
+
+        # technique -> [(path_str, group_params)] resolved against the pytree
+        self.plans: Dict[str, List[Tuple[str, Dict]]] = {}
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        all_paths = [(_path_str(p), leaf) for p, leaf in flat]
+        for technique in (WEIGHT_QUANTIZATION,) + _PRUNE_TECHNIQUES:
+            tcfg = self.config.get(technique, {})
+            if not tcfg.get("shared_parameters", {}).get("enabled", False):
+                continue
+            plan = []
+            for gname, group in tcfg.get("different_groups", {}).items():
+                patterns = group.get("modules", ["*"])
+                gparams = dict(group.get("params", {}))
+                matched = [p for p, leaf in all_paths
+                           if _match(p, patterns) and getattr(leaf, "ndim", 0) >= 2]
+                if not matched:
+                    logger.warning(f"compression group {technique}/{gname}: no parameters match {patterns}")
+                for p in matched:
+                    plan.append((p, gparams))
+            self.plans[technique] = plan
+        self._plan_lookup = {t: dict(plan) for t, plan in self.plans.items()}
+
+    # ------------------------------------------------------------------
+    def comp_state(self) -> Dict[str, jnp.ndarray]:
+        """Per-step traced scalars: active flags + annealed bit width."""
+        return {
+            "wq_active": jnp.asarray(self.scheduler.is_active(WEIGHT_QUANTIZATION)),
+            "wq_bits": jnp.asarray(self.scheduler.current_bits(WEIGHT_QUANTIZATION), jnp.float32),
+            "sparse_active": jnp.asarray(self.scheduler.is_active(SPARSE_PRUNING)),
+            "row_active": jnp.asarray(self.scheduler.is_active(ROW_PRUNING)),
+            "head_active": jnp.asarray(self.scheduler.is_active(HEAD_PRUNING)),
+            "channel_active": jnp.asarray(self.scheduler.is_active(CHANNEL_PRUNING)),
+        }
+
+    def _compress_leaf(self, path: str, w: jnp.ndarray, state: Dict, hard: bool = False) -> jnp.ndarray:
+        out = w
+        lookup = self._plan_lookup
+        gp = lookup.get(SPARSE_PRUNING, {}).get(path)
+        if gp is not None:
+            mask = magnitude_mask(out, gp.get("dense_ratio", 0.5))
+            masked = out * mask
+            out = masked if hard else jnp.where(state["sparse_active"], masked, out)
+        gp = lookup.get(ROW_PRUNING, {}).get(path)
+        if gp is not None:
+            mask = row_pruning_mask(out, gp.get("dense_ratio", 0.5))
+            masked = out * mask
+            out = masked if hard else jnp.where(state["row_active"], masked, out)
+        gp = lookup.get(HEAD_PRUNING, {}).get(path)
+        if gp is not None:
+            heads = gp.get("num_heads", self.num_heads)
+            if heads:
+                mask = head_pruning_mask(out, heads, gp.get("dense_ratio", 0.5))
+                masked = out * mask
+                out = masked if hard else jnp.where(state["head_active"], masked, out)
+        gp = lookup.get(CHANNEL_PRUNING, {}).get(path)
+        if gp is not None:
+            mask = channel_pruning_mask(out, gp.get("dense_ratio", 0.5))
+            masked = out * mask
+            out = masked if hard else jnp.where(state["channel_active"], masked, out)
+        gp = lookup.get(WEIGHT_QUANTIZATION, {}).get(path)
+        if gp is not None:
+            shared = self.config[WEIGHT_QUANTIZATION].get("shared_parameters", {})
+            symmetric = shared.get("quantization_type", "symmetric") == "symmetric"
+            groups = int(shared.get("quantize_groups", 1))
+            if hard:
+                bits = self.scheduler.current_bits(WEIGHT_QUANTIZATION)
+                out = fake_quantize(out, bits if bits < 32 else gp.get("target_bits", 8),
+                                    symmetric=symmetric, num_groups=groups)
+            else:
+                # traced bits: annealing steps don't recompile
+                quant = fake_quantize(out, state["wq_bits"], symmetric=symmetric, num_groups=groups)
+                out = jnp.where(state["wq_active"], quant, out)
+        return out
+
+    def apply(self, params, state: Dict):
+        """QAT/pruning transform for the forward pass (inside the grad)."""
+        if not any(self.plans.values()):
+            return params
+
+        def leaf(path, w):
+            return self._compress_leaf(_path_str(path), w, state)
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+    def clean(self, params):
+        """Make compression permanent (reference ``redundancy_clean``)."""
+        def leaf(path, w):
+            return self._compress_leaf(_path_str(path), w, {}, hard=True)
+
+        return jax.tree_util.tree_map_with_path(leaf, params)
+
+
+def init_compression(model_or_params, deepspeed_config, teacher_model=None, mpu=None,
+                     num_heads: Optional[int] = None) -> CompressionEngine:
+    """Build a CompressionEngine from a params tree (or a model exposing
+    one) + ds config. Reference API: ``compress.py:100``."""
+    if isinstance(deepspeed_config, dict):
+        comp = deepspeed_config.get("compression_training", deepspeed_config)
+    else:
+        comp = getattr(deepspeed_config, "compression_config", {})
+    params = model_or_params
+    if hasattr(model_or_params, "params"):
+        params = model_or_params.params
+    if num_heads is None and hasattr(model_or_params, "cfg"):
+        num_heads = getattr(model_or_params.cfg, "n_heads", None)
+    return CompressionEngine(params, comp, num_heads=num_heads)
+
+
+def redundancy_clean(params, deepspeed_config, mpu=None, num_heads: Optional[int] = None):
+    """One-shot permanent compression of a trained params tree."""
+    engine = init_compression(params, deepspeed_config, num_heads=num_heads)
+    return engine.clean(params)
+
+
+def student_initialization(student_params, teacher_params, deepspeed_config):
+    """Layer-reduction init: copy chosen teacher layers into the student
+    (reference ``compress.py:192``). Layer params must live under
+    ``<module_name_prefix>_<i>`` path segments (our transformer layout)."""
+    comp = deepspeed_config.get("compression_training", deepspeed_config)
+    lr_cfg = comp.get(LAYER_REDUCTION, {})
+    if not lr_cfg.get("enabled", False):
+        return student_params
+    prefix = lr_cfg.get("module_name_prefix", "layers")
+    teacher_layers = lr_cfg.get("teacher_layer", [])
+
+    flat_t = dict(jax.tree_util.tree_flatten_with_path(teacher_params)[0])
+    flat_s, treedef = jax.tree_util.tree_flatten_with_path(student_params)
+    out = []
+    for path, leaf in flat_s:
+        pstr = _path_str(path)
+        new_leaf = leaf
+        for student_idx, teacher_idx in enumerate(teacher_layers):
+            s_seg, t_seg = f"{prefix}_{student_idx}", f"{prefix}_{teacher_idx}"
+            if f"{s_seg}/" in pstr + "/" or pstr.endswith(s_seg):
+                t_path = pstr.replace(s_seg, t_seg)
+                match = next((l for p, l in flat_t.items() if _path_str(p) == t_path), None)
+                if match is not None and match.shape == leaf.shape:
+                    new_leaf = match
+                break
+        out.append(new_leaf)
+    other = lr_cfg.get("other_module_name", []) + [lr_cfg.get("embedding_name", "embed")]
+    for i, (path, leaf) in enumerate(flat_s):
+        pstr = _path_str(path)
+        if f"{prefix}_" in pstr:
+            continue
+        if _match(pstr, [m for m in other if m]):
+            match = next((l for p, l in flat_t.items() if _path_str(p) == pstr), None)
+            if match is not None and match.shape == leaf.shape:
+                out[i] = match
+    return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(student_params), out)

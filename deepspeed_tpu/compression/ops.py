@@ -1,0 +1,124 @@
+"""Compression numeric ops: fake quantization + pruning masks.
+
+Parity: reference ``compression/basic_layer.py`` (``LinearLayer_Compress``
+:121 quantize/prune mixins) + ``compression/utils.py`` (quantizer math).
+Torch modules mutate their weights in-place; here every op is a pure
+function over arrays — the straight-through estimator is
+``w + stop_gradient(q(w) - w)``, which XLA folds into the fwd/bwd pair
+the same way the reference's autograd Function does.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _per_group(w: jnp.ndarray, num_groups: int) -> Tuple[jnp.ndarray, Tuple[int, ...]]:
+    """Reshape to (num_groups, -1) for group-wise quantization ranges."""
+    shape = w.shape
+    if num_groups <= 1:
+        return w.reshape(1, -1), shape
+    if w.size % num_groups != 0:
+        return w.reshape(1, -1), shape
+    return w.reshape(num_groups, -1), shape
+
+
+def fake_quantize(w: jnp.ndarray, bits, symmetric: bool = True, num_groups: int = 1,
+                  stochastic: bool = False, rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Quantization-aware-training fake quant with straight-through grads.
+
+    Reference: ``basic_layer.py:319 enable_weight_quantization`` +
+    ``utils.py`` symmetric/asymmetric quantizers. ``bits`` may be a python
+    int or a traced scalar (annealing without recompilation).
+    """
+    if isinstance(bits, (int, float)) and bits >= 32:
+        return w
+    g, shape = _per_group(w.astype(jnp.float32), num_groups)
+    if symmetric:
+        qmax = 2.0**(bits - 1) - 1
+        scale = jnp.max(jnp.abs(g), axis=1, keepdims=True) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = g / scale
+        q = q + jax.random.uniform(rng, q.shape, minval=-0.5, maxval=0.5) if stochastic and rng is not None else q
+        q = jnp.clip(jnp.round(q), -qmax - 1, qmax) * scale
+    else:
+        qmax = 2.0**bits - 1
+        lo = jnp.min(g, axis=1, keepdims=True)
+        hi = jnp.max(g, axis=1, keepdims=True)
+        scale = (hi - lo) / qmax
+        scale = jnp.where(scale == 0, 1.0, scale)
+        q = (g - lo) / scale
+        q = q + jax.random.uniform(rng, q.shape, minval=-0.5, maxval=0.5) if stochastic and rng is not None else q
+        q = jnp.clip(jnp.round(q), 0, qmax) * scale + lo
+    q = q.reshape(shape).astype(w.dtype)
+    # straight-through estimator
+    return w + jax.lax.stop_gradient(q - w)
+
+
+def quantize_activation(x: jnp.ndarray, bits: int, symmetric: bool = True,
+                        static_range: Optional[Tuple[float, float]] = None) -> jnp.ndarray:
+    """Activation fake quant (reference ``QuantAct`` :17). Dynamic range by
+    default; pass ``static_range`` for calibrated static quantization."""
+    if bits >= 32:
+        return x
+    if static_range is not None:
+        lo, hi = static_range
+        lo = jnp.asarray(lo, jnp.float32)
+        hi = jnp.asarray(hi, jnp.float32)
+    elif symmetric:
+        hi = jnp.max(jnp.abs(x)).astype(jnp.float32)
+        lo = -hi
+    else:
+        lo = jnp.min(x).astype(jnp.float32)
+        hi = jnp.max(x).astype(jnp.float32)
+    qmax = 2.0**bits - 1
+    scale = jnp.where(hi - lo == 0, 1.0, (hi - lo) / qmax)
+    q = jnp.clip(jnp.round((x - lo) / scale), 0, qmax) * scale + lo
+    return x + jax.lax.stop_gradient(q.astype(x.dtype) - x)
+
+
+def magnitude_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Unstructured (sparse) pruning mask keeping the top |dense_ratio|
+    fraction by magnitude (reference ``enable_sparse_pruning`` method=l1)."""
+    k = max(1, int(round(w.size * dense_ratio)))
+    flat = jnp.abs(w).reshape(-1)
+    threshold = jax.lax.top_k(flat, k)[0][-1]
+    return (jnp.abs(w) >= threshold).astype(w.dtype)
+
+
+def row_pruning_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Structured row mask by L1 row norm (reference ``enable_row_pruning``).
+    ``w``: (out, in) with rows = output neurons."""
+    rows = w.shape[0]
+    k = max(1, int(round(rows * dense_ratio)))
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(1, w.ndim)))
+    threshold = jax.lax.top_k(norms, k)[0][-1]
+    return (norms >= threshold).astype(w.dtype).reshape((rows,) + (1,) * (w.ndim - 1))
+
+
+def channel_pruning_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Structured input-channel mask by L1 column norm (reference
+    ``Conv2dLayer_Compress.enable_channel_pruning``). Masks along the last
+    (input) axis."""
+    cols = w.shape[-1]
+    k = max(1, int(round(cols * dense_ratio)))
+    norms = jnp.sum(jnp.abs(w), axis=tuple(range(w.ndim - 1)))
+    threshold = jax.lax.top_k(norms, k)[0][-1]
+    return (norms >= threshold).astype(w.dtype).reshape((1,) * (w.ndim - 1) + (cols,))
+
+
+def head_pruning_mask(w: jnp.ndarray, num_heads: int, dense_ratio: float) -> jnp.ndarray:
+    """Attention-head mask by per-head L1 norm over an output-projection
+    weight (reference ``enable_head_pruning``): w (out, in) with the *input*
+    dim split into heads."""
+    in_dim = w.shape[-1]
+    if in_dim % num_heads != 0:
+        raise ValueError(f"input dim {in_dim} not divisible by num_heads {num_heads}")
+    per_head = in_dim // num_heads
+    k = max(1, int(round(num_heads * dense_ratio)))
+    heads = w.reshape(-1, num_heads, per_head)
+    norms = jnp.sum(jnp.abs(heads), axis=(0, 2))
+    threshold = jax.lax.top_k(norms, k)[0][-1]
+    head_mask = (norms >= threshold).astype(w.dtype)
+    return jnp.repeat(head_mask, per_head).reshape(1, in_dim)

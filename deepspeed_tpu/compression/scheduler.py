@@ -1,0 +1,53 @@
+"""Compression scheduler: when each technique becomes active.
+
+Parity: reference ``compression/scheduler.py`` (``CompressionScheduler``:
+engine calls ``step()`` every global step; techniques activate at their
+``schedule_offset`` and, for quantization, anneal start_bits->target_bits
+every ``quantization_period`` steps).
+"""
+
+from typing import Dict
+
+
+class CompressionScheduler:
+
+    def __init__(self, technique_configs: Dict[str, Dict]):
+        """``technique_configs``: {technique_name: shared_parameters dict}
+        with keys like schedule_offset / schedule_offset_end."""
+        self.configs = technique_configs
+        self.training_steps = 0
+
+    def step(self, step_zero_check: bool = False) -> None:
+        if not step_zero_check:
+            self.training_steps += 1
+
+    def is_active(self, technique: str) -> bool:
+        cfg = self.configs.get(technique)
+        if cfg is None or not cfg.get("enabled", False):
+            return False
+        start = cfg.get("schedule_offset", 0)
+        end = cfg.get("schedule_offset_end", None)
+        if self.training_steps < start:
+            return False
+        if end is not None and end > 0 and self.training_steps > end:
+            return False
+        return True
+
+    def current_bits(self, technique: str = "weight_quantization") -> int:
+        """Annealed bit width: start_bits stepping down toward target_bits
+        once per quantization_period after activation."""
+        cfg = self.configs.get(technique, {})
+        start_bits = cfg.get("quantize_weight_in_forward_start_bits", cfg.get("start_bits", 8))
+        target_bits = cfg.get("target_bits", start_bits)
+        if not self.is_active(technique):
+            return 32
+        period = max(1, cfg.get("quantization_period", 1))
+        active_steps = self.training_steps - cfg.get("schedule_offset", 0)
+        bits = start_bits - active_steps // period
+        return int(max(bits, target_bits))
+
+    def state_dict(self) -> Dict:
+        return {"training_steps": self.training_steps}
+
+    def load_state_dict(self, sd: Dict) -> None:
+        self.training_steps = int(sd.get("training_steps", 0))

@@ -158,6 +158,13 @@ def select_runner(launcher: str, args, world_info_base64: str) -> MultiNodeRunne
             raise RuntimeError(f"launcher backend '{name}' is not usable on this machine "
                                "(binary missing from PATH, or gcloud without a TPU name)")
         return runner
+    if getattr(args, "tpu_name", "") or os.environ.get("TPU_NAME"):
+        # an explicit TPU pod target must not silently fall back to ssh
+        runner = RUNNER_CLASSES["gcloud"](args, world_info_base64)
+        if not runner.backend_exists():
+            raise RuntimeError("a TPU name is set but the gcloud CLI is not on PATH; install it or "
+                               "pass --launcher to choose another backend explicitly")
+        return runner
     for name in _AUTO_DETECT_ORDER:
         runner = RUNNER_CLASSES[name](args, world_info_base64)
         if runner.backend_exists():

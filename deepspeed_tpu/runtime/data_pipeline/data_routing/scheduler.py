@@ -63,10 +63,14 @@ class RandomLTDScheduler(BaseScheduler):
         self.first_step = True
 
     def get_total_layer_tokens(self, train_iters: int) -> int:
-        """Total tokens processed by the random-ltd layers over a run."""
+        """Total tokens processed by the random-ltd layers over a run
+        (pure: simulates the schedule without touching live state)."""
+        import copy
+
+        sim = copy.deepcopy(self)
         total = 0
         for step in range(train_iters):
-            total += self.update_seq(step) * len(self.random_ltd_layer_id)
+            total += sim.update_seq(step) * len(self.random_ltd_layer_id)
         return total
 
     def reset_to_init(self) -> None:

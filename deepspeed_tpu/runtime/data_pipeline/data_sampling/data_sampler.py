@@ -128,19 +128,26 @@ class DeepSpeedDataSampler:
         return start, start + per_rank
 
     def __iter__(self) -> Iterator[List[int]]:
+        # without-replacement queue: each eligible sample is consumed once
+        # per pass (epoch semantics, like the reference's cluster draws);
+        # under curriculum the queue is re-filtered as the bound moves
+        queue = np.array([], dtype=self.index_dtype)
         while self.consumed_samples < self.total_samples:
             if self.curriculum_enabled:
                 self._advance_curriculum()
                 pool = self._eligible_pool()
+                eligible = np.zeros(self.one_epoch_total_samples, dtype=bool)
+                eligible[pool] = True
+                queue = queue[eligible[queue]]
             else:
                 pool = self._epoch_perm
             take = self.global_batch_size
-            if len(pool) < take:
-                if self.drop_last and not self.curriculum_enabled:
-                    return
-                reps = -(-take // len(pool))
-                pool = np.tile(pool, reps)
-            chosen = self.np_rng.choice(pool, size=take, replace=False) if len(pool) >= take else pool[:take]
+            if self.drop_last and not self.curriculum_enabled and \
+                    self.total_samples - self.consumed_samples < take:
+                return
+            while len(queue) < take:
+                queue = np.concatenate([queue, self.np_rng.permutation(pool).astype(self.index_dtype)])
+            chosen, queue = queue[:take], queue[take:]
             self.consumed_samples += take
             for micro in np.array_split(chosen, self.gradient_accumulation_steps):
                 start, end = self.get_start_end_idx(len(micro))

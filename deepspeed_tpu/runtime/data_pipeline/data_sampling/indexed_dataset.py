@@ -76,7 +76,10 @@ class MMapIndexedDataset:
             self._sizes = np.frombuffer(f.read(8 * count), dtype=np.int64)
         self._offsets = np.zeros(count + 1, dtype=np.int64)
         np.cumsum(self._sizes, out=self._offsets[1:])
-        self._data = np.memmap(bin_path, dtype=self._dtype, mode="r")
+        if bin_path.stat().st_size == 0:  # empty shard (np.memmap rejects empty files)
+            self._data = np.empty(0, dtype=self._dtype)
+        else:
+            self._data = np.memmap(bin_path, dtype=self._dtype, mode="r")
 
     def __len__(self) -> int:
         return len(self._sizes)

@@ -216,6 +216,16 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
 
+        # compression training (reference compression/compress.py): a pure
+        # params transform applied inside the differentiated loss
+        self.compression_engine = None
+        if self.config.compression_config:
+            from ..compression.compress import CompressionEngine
+
+            model_cfg = getattr(model, "cfg", None)
+            self.compression_engine = CompressionEngine(self.params, self.config.compression_config,
+                                                        num_heads=getattr(model_cfg, "n_heads", None))
+
         self._build_compiled_fns()
         log_dist(
             f"DeepSpeedEngine: stage={self.zero_optimization_stage()} dtype={self.compute_dtype.__name__} "
@@ -228,17 +238,26 @@ class DeepSpeedEngine:
     def _build_compiled_fns(self):
         loss_fn = self._loss_fn
         compute_dtype = self.compute_dtype
+        comp = self.compression_engine
 
-        def scaled_loss_fn(params32, batch, rng, scale):
+        def scaled_loss_fn(params32, batch, rng, scale, comp_state):
             params_c = _cast_tree(params32, compute_dtype)
+            if comp is not None:
+                params_c = comp.apply(params_c, comp_state)
             loss = loss_fn(params_c, batch, rng)
             return (loss * scale).astype(jnp.float32), loss
 
-        def fwd_bwd(params32, batch, rng, scale):
-            (scaled, raw_loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(params32, batch, rng, scale)
+        def fwd_bwd(params32, batch, rng, scale, comp_state):
+            (scaled, raw_loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(
+                params32, batch, rng, scale, comp_state)
             return raw_loss, grads
 
-        self._fwd_bwd = jax.jit(fwd_bwd, out_shardings=(None, self.grad_shardings))
+        if comp is None:
+            self._fwd_bwd = jax.jit(lambda p, b, r, s: fwd_bwd(p, b, r, s, None),
+                                    out_shardings=(None, self.grad_shardings))
+        else:
+            self._fwd_bwd_comp = jax.jit(fwd_bwd, out_shardings=(None, self.grad_shardings))
+            self._fwd_bwd = lambda p, b, r, s: self._fwd_bwd_comp(p, b, r, s, comp.comp_state())
 
         def accumulate(acc, grads):
             return jax.tree_util.tree_map(lambda a, g: a + g.astype(a.dtype), acc, grads)
@@ -384,6 +403,8 @@ class DeepSpeedEngine:
         self.global_steps += 1
         if self.random_ltd_scheduler is not None:
             self.random_ltd_scheduler.update_seq(self.global_steps)
+        if self.compression_engine is not None:
+            self.compression_engine.scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
         if self.global_steps % self.config.steps_per_print == 0:
             self._report(lr)
@@ -575,6 +596,9 @@ class DeepSpeedEngine:
                 self.micro_steps = int(state["micro_steps"])
                 self.global_samples = int(state["global_samples"])
                 self.skipped_steps = int(state["skipped_steps"])
+                if self.compression_engine is not None:
+                    # scheduler state is just the step counter
+                    self.compression_engine.scheduler.training_steps = self.global_steps
             curriculum_path = os.path.join(d, CURRICULUM_STATE_FILENAME)
             if self.curriculum_scheduler is not None and os.path.exists(curriculum_path):
                 self.curriculum_scheduler.set_state(self.checkpoint_engine.load(curriculum_path))

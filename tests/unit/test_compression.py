@@ -1,0 +1,215 @@
+"""Compression library tests.
+
+Mirrors reference ``tests/unit/compression/test_compression.py``: numeric
+checks on quantize/prune ops, config-group resolution, scheduler windows,
+QAT engine integration (loss stays finite, grads flow to raw weights),
+redundancy_clean permanence, layer-reduction student init.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.compression import (CompressionEngine, CompressionScheduler, fake_quantize, head_pruning_mask,
+                                       init_compression, magnitude_mask, quantize_activation, redundancy_clean,
+                                       row_pruning_mask, student_initialization)
+
+
+# -------------------- ops --------------------
+def test_fake_quantize_levels():
+    w = jnp.linspace(-1.0, 1.0, 64).reshape(8, 8)
+    q = fake_quantize(w, bits=4, symmetric=True)
+    # at most 16 distinct levels
+    assert len(np.unique(np.asarray(q).round(6))) <= 16
+    # 32-bit is the identity
+    np.testing.assert_array_equal(np.asarray(fake_quantize(w, bits=32)), np.asarray(w))
+    # asymmetric hits min and max exactly
+    qa = fake_quantize(w, bits=4, symmetric=False)
+    assert np.isclose(np.asarray(qa).min(), -1.0) and np.isclose(np.asarray(qa).max(), 1.0)
+
+
+def test_fake_quantize_straight_through_grads():
+    w = jnp.linspace(-1.0, 1.0, 16).reshape(4, 4)
+    g = jax.grad(lambda x: jnp.sum(fake_quantize(x, bits=4)))(w)
+    np.testing.assert_allclose(np.asarray(g), np.ones((4, 4)), rtol=1e-6)
+
+
+def test_quantize_activation_static_range():
+    x = jnp.asarray([-2.0, -1.0, 0.0, 1.0, 2.0])
+    q = quantize_activation(x, bits=8, static_range=(-1.0, 1.0))
+    assert np.asarray(q).max() <= 1.0 + 1e-6
+
+
+def test_magnitude_mask_ratio():
+    w = jnp.arange(1.0, 101.0).reshape(10, 10)
+    mask = magnitude_mask(w, dense_ratio=0.3)
+    assert int(np.asarray(mask).sum()) == 30
+    # keeps the largest
+    assert np.asarray(mask).reshape(-1)[-1] == 1 and np.asarray(mask).reshape(-1)[0] == 0
+
+
+def test_row_and_head_masks():
+    w = jnp.concatenate([jnp.ones((2, 8)), 0.01 * jnp.ones((6, 8))], axis=0)
+    mask = row_pruning_mask(w, dense_ratio=0.25)
+    assert np.asarray(mask)[:2].all() and not np.asarray(mask)[2:].any()
+    w2 = jnp.concatenate([jnp.ones((4, 8)), 0.01 * jnp.ones((4, 8))], axis=1)
+    hm = head_pruning_mask(w2, num_heads=4, dense_ratio=0.5)
+    assert hm.shape == (1, 16)
+    assert np.asarray(hm)[0, :8].all() and not np.asarray(hm)[0, 8:].any()
+    with pytest.raises(ValueError):
+        head_pruning_mask(w2, num_heads=5, dense_ratio=0.5)
+
+
+# -------------------- scheduler --------------------
+def test_scheduler_windows_and_bit_annealing():
+    sched = CompressionScheduler({
+        "weight_quantization": {"enabled": True, "schedule_offset": 3, "start_bits": 8, "target_bits": 4,
+                                "quantization_period": 2},
+        "sparse_pruning": {"enabled": True, "schedule_offset": 0, "schedule_offset_end": 5},
+    })
+    assert not sched.is_active("weight_quantization")
+    assert sched.current_bits() == 32
+    for _ in range(3):
+        sched.step()
+    assert sched.is_active("weight_quantization") and sched.current_bits() == 8
+    for _ in range(4):
+        sched.step()
+    assert sched.current_bits() == 6  # annealed 2 periods
+    for _ in range(20):
+        sched.step()
+    assert sched.current_bits() == 4  # floor at target
+    assert not sched.is_active("sparse_pruning")  # window closed
+
+
+# -------------------- engine-level --------------------
+def _toy_params():
+    k = jax.random.PRNGKey(0)
+    return {
+        "layers_0": {"attn": {"kernel": jax.random.normal(k, (16, 16))},
+                     "mlp": {"kernel": jax.random.normal(k, (16, 32))}},
+        "layers_1": {"attn": {"kernel": jax.random.normal(k, (16, 16))},
+                     "mlp": {"kernel": jax.random.normal(k, (16, 32))}},
+        "embed": {"embedding": jax.random.normal(k, (64, 16))},
+    }
+
+
+_COMP_CFG = {
+    "weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0, "quantization_type": "symmetric",
+                              "quantize_groups": 1},
+        "different_groups": {"wq1": {"params": {"start_bits": 8, "target_bits": 8, "quantization_period": 1},
+                                     "modules": ["attn"]}},
+    },
+    "sparse_pruning": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0, "method": "l1"},
+        "different_groups": {"sp1": {"params": {"dense_ratio": 0.5}, "modules": ["mlp"]}},
+    },
+}
+
+
+def test_channel_pruning_applied():
+    from deepspeed_tpu.compression import channel_pruning_mask
+
+    w = jnp.concatenate([jnp.ones((8, 4)), 0.01 * jnp.ones((8, 4))], axis=1)
+    mask = channel_pruning_mask(w, dense_ratio=0.5)
+    assert mask.shape == (1, 8)
+    assert np.asarray(mask)[0, :4].all() and not np.asarray(mask)[0, 4:].any()
+    params = _toy_params()
+    cfg = {"channel_pruning": {"shared_parameters": {"enabled": True, "schedule_offset": 0},
+                               "different_groups": {"cp": {"params": {"dense_ratio": 0.5}, "modules": ["mlp"]}}}}
+    eng = CompressionEngine(params, cfg)
+    out = eng.apply(params, eng.comp_state())
+    mlp = np.asarray(out["layers_0"]["mlp"]["kernel"])
+    assert np.isclose((np.abs(mlp).sum(axis=0) == 0).mean(), 0.5, atol=0.05)
+
+
+def test_partial_group_params_no_crash():
+    # a group omitting start_bits must not poison the scheduler with None
+    params = _toy_params()
+    cfg = {"weight_quantization": {"shared_parameters": {"enabled": True, "schedule_offset": 0},
+                                   "different_groups": {"wq": {"params": {"target_bits": 8},
+                                                               "modules": ["attn"]}}}}
+    eng = CompressionEngine(params, cfg)
+    state = eng.comp_state()  # must not raise
+    eng.apply(params, state)
+
+
+def test_engine_group_resolution_and_apply():
+    params = _toy_params()
+    eng = CompressionEngine(params, _COMP_CFG)
+    assert len(eng.plans["weight_quantization"]) == 2  # both attn kernels
+    assert len(eng.plans["sparse_pruning"]) == 2
+    out = eng.apply(params, eng.comp_state())
+    # quantized attn has few levels; mlp is half zeros; embed untouched
+    attn = np.asarray(out["layers_0"]["attn"]["kernel"])
+    assert len(np.unique(attn.round(5))) <= 256
+    mlp = np.asarray(out["layers_0"]["mlp"]["kernel"])
+    assert np.isclose((mlp == 0).mean(), 0.5, atol=0.05)
+    np.testing.assert_array_equal(np.asarray(out["embed"]["embedding"]),
+                                  np.asarray(params["embed"]["embedding"]))
+
+
+def test_inactive_schedule_is_identity():
+    params = _toy_params()
+    cfg = {"sparse_pruning": {"shared_parameters": {"enabled": True, "schedule_offset": 100},
+                              "different_groups": {"sp1": {"params": {"dense_ratio": 0.5}, "modules": ["mlp"]}}}}
+    eng = CompressionEngine(params, cfg)
+    out = eng.apply(params, eng.comp_state())
+    np.testing.assert_array_equal(np.asarray(out["layers_0"]["mlp"]["kernel"]),
+                                  np.asarray(params["layers_0"]["mlp"]["kernel"]))
+
+
+def test_redundancy_clean_permanent():
+    params = _toy_params()
+    cleaned = redundancy_clean(params, {"compression_training": _COMP_CFG})
+    mlp = np.asarray(cleaned["layers_0"]["mlp"]["kernel"])
+    assert np.isclose((mlp == 0).mean(), 0.5, atol=0.05)
+
+
+def test_student_initialization_layer_reduction():
+    teacher = _toy_params()
+    student = {
+        "layers_0": jax.tree_util.tree_map(jnp.zeros_like, teacher["layers_0"]),
+        "embed": {"embedding": jnp.zeros((64, 16))},
+    }
+    cfg = {"compression_training": {"layer_reduction": {
+        "enabled": True, "keep_number_layer": 1, "module_name_prefix": "layers",
+        "teacher_layer": [1], "embedding_name": "embed", "other_module_name": []}}}
+    out = student_initialization(student, teacher, cfg)
+    np.testing.assert_array_equal(np.asarray(out["layers_0"]["attn"]["kernel"]),
+                                  np.asarray(teacher["layers_1"]["attn"]["kernel"]))
+    np.testing.assert_array_equal(np.asarray(out["embed"]["embedding"]),
+                                  np.asarray(teacher["embed"]["embedding"]))
+
+
+def test_training_with_compression():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+        "steps_per_print": 100,
+        "compression_training": {
+            "weight_quantization": {
+                "shared_parameters": {"enabled": True, "schedule_offset": 2, "quantization_type": "symmetric"},
+                "different_groups": {"wq": {"params": {"start_bits": 8, "target_bits": 8,
+                                                       "quantization_period": 1},
+                                            "modules": ["attn", "mlp"]}},
+            },
+        },
+    }
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    assert engine.compression_engine is not None
+    rng = np.random.RandomState(0)
+    data = [{"input_ids": rng.randint(0, 1024, size=(16,)).astype(np.int32)} for _ in range(16)]
+    it = RepeatingLoader(engine.deepspeed_io(data))
+    losses = [float(engine.train_batch(it)) for _ in range(5)]
+    assert all(np.isfinite(l) for l in losses)
+    assert engine.compression_engine.scheduler.is_active("weight_quantization")
+    assert losses[-1] < losses[0]  # QAT still learns

@@ -203,10 +203,10 @@ def _sampler(metric_vals, difficulty_cfg, micro=2, dp=2, gas=1):
 
 def test_sampler_respects_difficulty_bound():
     vals = np.array([1, 2, 3, 4, 5, 6, 7, 8] * 4)
-    sampler = _sampler(vals, (2, 8, 8), micro=2, dp=2)
+    sampler = _sampler(vals, (2, 8, 8), micro=4, dp=1)
     it = iter(sampler)
     first = next(it)
-    assert len(first) == 2  # this rank's share of the global micro batch
+    assert len(first) == 4
     # early steps: only low-difficulty samples eligible
     assert all(vals[i] <= 3 for i in first)
     hardest_seen = 0
@@ -235,6 +235,37 @@ def test_sampler_len_and_no_curriculum():
     assert len(sampler) == 24
     batch = next(iter(sampler))
     assert len(batch) == 2 and all(0 <= i < 8 for i in batch)
+
+
+def test_sampler_epoch_without_replacement():
+    # one epoch of 8 samples, global batch 4, dp=1: every sample exactly once
+    cfg = {"data_sampling": {"num_epochs": 1}}
+    sampler = DeepSpeedDataSampler(cfg, one_epoch_total_samples=8, micro_batch_size=4, data_parallel_rank=0,
+                                   data_parallel_size=1)
+    seen = [i for batch in sampler for i in batch]
+    assert sorted(seen) == list(range(8))
+
+
+def test_analyzer_uneven_worker_shards(tmp_path):
+    # 6 samples over 4 workers: worker 3's shard is empty — reduce must cope
+    dataset = list(range(6))
+    metric = lambda batch: [x + 1 for x in batch]
+    for w in range(4):
+        DataAnalyzer(dataset, str(tmp_path), ["m"], [metric], num_workers=4, worker_id=w).run_map()
+    DataAnalyzer(dataset, str(tmp_path), ["m"], [metric], num_workers=4, worker_id=0).run_reduce()
+    np.testing.assert_array_equal(DataAnalyzer.load_metric(str(tmp_path), "m"), np.arange(1, 7))
+
+
+def test_random_ltd_total_tokens_is_pure():
+    sched = RandomLTDScheduler({
+        "random_ltd_layer_id": [0, 1],
+        "random_ltd_schedule": {"min_value": 16, "max_value": 64, "schedule_type": "fixed_linear",
+                                "schedule_config": {"total_curriculum_step": 4, "difficulty_step": 16}},
+    })
+    before = dict(sched.state_dict())
+    total = sched.get_total_layer_tokens(10)
+    assert total > 0
+    assert sched.state_dict() == before  # no side effects on live state
 
 
 # -------------------- engine integration --------------------
