@@ -86,10 +86,13 @@ class CompressionEngine:
 
     # ------------------------------------------------------------------
     def comp_state(self) -> Dict[str, jnp.ndarray]:
-        """Per-step traced scalars: active flags + annealed bit width."""
+        """Per-step traced scalars: active flags + quantization progress.
+        Bit widths are derived per group from ``wq_steps`` (steps since the
+        quantization schedule activated), so groups anneal independently."""
+        wq_offset = self.scheduler.configs.get(WEIGHT_QUANTIZATION, {}).get("schedule_offset", 0)
         return {
             "wq_active": jnp.asarray(self.scheduler.is_active(WEIGHT_QUANTIZATION)),
-            "wq_bits": jnp.asarray(self.scheduler.current_bits(WEIGHT_QUANTIZATION), jnp.float32),
+            "wq_steps": jnp.asarray(max(0, self.scheduler.training_steps - wq_offset), jnp.float32),
             "sparse_active": jnp.asarray(self.scheduler.is_active(SPARSE_PRUNING)),
             "row_active": jnp.asarray(self.scheduler.is_active(ROW_PRUNING)),
             "head_active": jnp.asarray(self.scheduler.is_active(HEAD_PRUNING)),
@@ -126,13 +129,17 @@ class CompressionEngine:
             shared = self.config[WEIGHT_QUANTIZATION].get("shared_parameters", {})
             symmetric = shared.get("quantization_type", "symmetric") == "symmetric"
             groups = int(shared.get("quantize_groups", 1))
+            start_b = gp.get("start_bits", 8)
+            target_b = gp.get("target_bits", start_b)
             if hard:
-                bits = self.scheduler.current_bits(WEIGHT_QUANTIZATION)
-                out = fake_quantize(out, bits if bits < 32 else gp.get("target_bits", 8),
-                                    symmetric=symmetric, num_groups=groups)
+                # permanence always lands at the group's final (target) width
+                out = fake_quantize(out, target_b, symmetric=symmetric, num_groups=groups)
             else:
-                # traced bits: annealing steps don't recompile
-                quant = fake_quantize(out, state["wq_bits"], symmetric=symmetric, num_groups=groups)
+                # per-group annealed traced bits: no recompiles, groups
+                # with different schedules anneal independently
+                period = max(1, gp.get("quantization_period", 1))
+                bits = jnp.maximum(start_b - jnp.floor(state["wq_steps"] / period), float(target_b))
+                quant = fake_quantize(out, bits, symmetric=symmetric, num_groups=groups)
                 out = jnp.where(state["wq_active"], quant, out)
         return out
 
@@ -187,7 +194,7 @@ def student_initialization(student_params, teacher_params, deepspeed_config):
     prefix = lr_cfg.get("module_name_prefix", "layers")
     teacher_layers = lr_cfg.get("teacher_layer", [])
 
-    flat_t = dict(jax.tree_util.tree_flatten_with_path(teacher_params)[0])
+    teacher_by_path = {_path_str(p): leaf for p, leaf in jax.tree_util.tree_flatten_with_path(teacher_params)[0]}
     flat_s, treedef = jax.tree_util.tree_flatten_with_path(student_params)
     out = []
     for path, leaf in flat_s:
@@ -196,8 +203,7 @@ def student_initialization(student_params, teacher_params, deepspeed_config):
         for student_idx, teacher_idx in enumerate(teacher_layers):
             s_seg, t_seg = f"{prefix}_{student_idx}", f"{prefix}_{teacher_idx}"
             if f"{s_seg}/" in pstr + "/" or pstr.endswith(s_seg):
-                t_path = pstr.replace(s_seg, t_seg)
-                match = next((l for p, l in flat_t.items() if _path_str(p) == t_path), None)
+                match = teacher_by_path.get(pstr.replace(s_seg, t_seg))
                 if match is not None and match.shape == leaf.shape:
                     new_leaf = match
                 break
@@ -208,7 +214,7 @@ def student_initialization(student_params, teacher_params, deepspeed_config):
         if f"{prefix}_" in pstr:
             continue
         if _match(pstr, [m for m in other if m]):
-            match = next((l for p, l in flat_t.items() if _path_str(p) == pstr), None)
+            match = teacher_by_path.get(pstr)
             if match is not None and match.shape == leaf.shape:
                 out[i] = match
     return jax.tree_util.tree_unflatten(jax.tree_util.tree_structure(student_params), out)

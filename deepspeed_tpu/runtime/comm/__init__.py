@@ -1,0 +1,3 @@
+from .compressed import (all_to_all_quant_reduce, compress_1bit, compressed_allreduce, reduce_scatter_coalesced)
+
+__all__ = ["compress_1bit", "compressed_allreduce", "all_to_all_quant_reduce", "reduce_scatter_coalesced"]

@@ -1,0 +1,8 @@
+from .optimizers import onebit_adam, onebit_lamb, zero_one_adam
+
+# reference class-name aliases (runtime/fp16/onebit/{adam,lamb,zoadam}.py)
+OnebitAdam = onebit_adam
+OnebitLamb = onebit_lamb
+ZeroOneAdam = zero_one_adam
+
+__all__ = ["onebit_adam", "onebit_lamb", "zero_one_adam", "OnebitAdam", "OnebitLamb", "ZeroOneAdam"]

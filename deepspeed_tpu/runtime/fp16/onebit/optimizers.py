@@ -1,0 +1,268 @@
+"""1-bit (communication-compressed) optimizers.
+
+Parity: reference ``runtime/fp16/onebit/adam.py`` (OnebitAdam :14),
+``zoadam.py`` (ZeroOneAdam), ``lamb.py`` (OnebitLamb). The algorithms:
+
+- **1-bit Adam**: standard Adam for ``freeze_step`` warmup steps; then the
+  variance ``nu`` is frozen and the *momentum* is sign-compressed with
+  error feedback before being shared across data-parallel workers.
+- **0/1 Adam**: like 1-bit Adam but the variance keeps updating at
+  exponentially spaced steps until ``var_freeze_step`` (no hard warmup).
+- **1-bit LAMB**: LAMB warmup; after freeze, momentum is compressed and
+  the per-tensor trust ratio reuses the scaling coefficient captured at
+  the freeze boundary.
+
+TPU-native shape: each is an ``optax.GradientTransformation`` whose
+compression runs per-leaf. When ``axis_name`` is given the transform must
+run inside ``shard_map`` and the compressed momentum is exchanged over
+that mesh axis via :func:`compressed_allreduce` (int8 on ICI). Without an
+``axis_name`` (the engine's SPMD path, where XLA already psums gradients
+over ICI) the quantization + error feedback still apply, so the update
+math — and therefore the loss trajectory — matches the reference's
+compression phase; only the wire transport differs, which on TPU is the
+point: psum over ICI is the fast path the reference lacked.
+"""
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...comm.compressed import compress_1bit, compressed_allreduce
+
+
+class OnebitState(NamedTuple):
+    count: jnp.ndarray
+    mu: optax.Updates
+    nu: optax.Updates
+    error: optax.Updates  # worker error feedback
+    server_error: optax.Updates
+    scaling_coeff: optax.Updates  # lamb only (zeros otherwise)
+
+
+def _zeros_like_tree(params):
+    return jax.tree_util.tree_map(lambda p: jnp.zeros_like(p, dtype=jnp.float32), params)
+
+
+_COMPRESS_GROUP = 2048  # elements per compression scale (chunk-granular, like the reference's per-chunk scales)
+
+
+def _compress_leaf(m, err, serr, axis_name: Optional[str]):
+    """Sign-compress a momentum leaf (+error feedback); returns the decoded
+    (averaged) momentum and the new error states (same shapes as err/serr)."""
+    if axis_name is not None:
+        flat = m.reshape(-1)
+        pad = err.size - flat.size  # err is the padded flat shape
+        flat_p = jnp.pad(flat, (0, pad)) if pad else flat
+        out, new_err, new_serr = compressed_allreduce(flat_p, err, serr, axis_name)
+        return out[:flat.size].reshape(m.shape), new_err, new_serr
+    # group-wise scales: one scale per <=2048 elements, or sign compression
+    # is far too coarse for large (e.g. embedding) leaves
+    flat = m.reshape(-1)
+    pad = err.size - flat.size
+    flat_p = jnp.pad(flat, (0, pad)) if pad else flat
+    g = min(_COMPRESS_GROUP, flat_p.size)
+    sign, scale, new_err = compress_1bit(flat_p.reshape(-1, g), err.reshape(-1, g))
+    dec = (scale * sign.astype(jnp.float32)).reshape(flat_p.shape)[:flat.size].reshape(m.shape)
+    return dec, new_err.reshape(err.shape), serr
+
+
+def _error_shapes(params, axis_name: Optional[str], world: int):
+    """(worker_error, server_error) zero trees, padded-flat per leaf."""
+    if axis_name is None:
+        def grouped(p):
+            g = min(_COMPRESS_GROUP, p.size)
+            n = p.size + ((-p.size) % g)
+            return jnp.zeros((n,), jnp.float32)
+
+        return jax.tree_util.tree_map(grouped, params), jax.tree_util.tree_map(
+            lambda p: jnp.zeros((), jnp.float32), params)
+
+    def padded(p):
+        n = p.size + ((-p.size) % world)
+        return jnp.zeros((n,), jnp.float32)
+
+    def chunk(p):
+        n = p.size + ((-p.size) % world)
+        return jnp.zeros((n // world,), jnp.float32)
+
+    return jax.tree_util.tree_map(padded, params), jax.tree_util.tree_map(chunk, params)
+
+
+def onebit_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100, axis_name: Optional[str] = None,
+                world: int = 1) -> optax.GradientTransformation:
+    """Reference ``OnebitAdam`` (``onebit/adam.py:14``)."""
+
+    def init(params):
+        err, serr = _error_shapes(params, axis_name, world)
+        return OnebitState(jnp.zeros((), jnp.int32), _zeros_like_tree(params), _zeros_like_tree(params),
+                           err, serr, _zeros_like_tree(params))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
+        in_warmup = count <= freeze_step
+        # variance: frozen after warmup
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
+            state.nu, grads)
+
+        def compressed_mu(m, e, se):
+            dec, ne, nse = _compress_leaf(m, e, se, axis_name)
+            return dec, ne, nse
+
+        comp = jax.tree_util.tree_map(compressed_mu, mu, state.error, state.server_error)
+        treedef = jax.tree_util.tree_structure(mu)
+        dec = jax.tree_util.tree_unflatten(treedef, [c[0] for c in jax.tree_util.tree_leaves(
+            comp, is_leaf=lambda x: isinstance(x, tuple))])
+        new_err = jax.tree_util.tree_unflatten(treedef, [c[1] for c in jax.tree_util.tree_leaves(
+            comp, is_leaf=lambda x: isinstance(x, tuple))])
+        new_serr = jax.tree_util.tree_unflatten(treedef, [c[2] for c in jax.tree_util.tree_leaves(
+            comp, is_leaf=lambda x: isinstance(x, tuple))])
+        # only pay the compression error after warmup; keep exact mu during
+        # it. Post-freeze the momentum BUFFER takes the decoded value, like
+        # the reference's in-place `exp_avg = compressed_allreduce(exp_avg)`
+        # (onebit/adam.py) — the residual lives solely in the error state,
+        # which keeps the feedback loop stable
+        used_mu = jax.tree_util.tree_map(lambda m, d: jnp.where(in_warmup, m, d), mu, dec)
+        kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.error, new_err)
+        kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.server_error, new_serr)
+
+        bc1 = 1 - b1**count.astype(jnp.float32)
+        bc2 = 1 - b2**jnp.minimum(count, freeze_step).astype(jnp.float32)
+
+        def step_leaf(m, v, p):
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay > 0 and p is not None:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return (-learning_rate * upd).astype(p.dtype if p is not None else jnp.float32)
+
+        updates = (jax.tree_util.tree_map(step_leaf, used_mu, nu, params) if params is not None else
+                   jax.tree_util.tree_map(lambda m, v: step_leaf(m, v, None), used_mu, nu))
+        return updates, OnebitState(count, used_mu, nu, kept_err, kept_serr, state.scaling_coeff)
+
+    return optax.GradientTransformation(init, update)
+
+
+def zero_one_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                  weight_decay: float = 0.0, var_freeze_step: int = 100, var_update_scaler: int = 16,
+                  axis_name: Optional[str] = None, world: int = 1) -> optax.GradientTransformation:
+    """Reference ``ZeroOneAdam`` (``onebit/zoadam.py``): no hard warmup —
+    variance refreshes at exponentially spaced steps until its freeze; the
+    momentum is always sign-compressed with error feedback."""
+
+    def init(params):
+        err, serr = _error_shapes(params, axis_name, world)
+        return OnebitState(jnp.zeros((), jnp.int32), _zeros_like_tree(params), _zeros_like_tree(params),
+                           err, serr, _zeros_like_tree(params))
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        fcount = count.astype(jnp.float32)
+        # variance update policy (reference zoadam.py:266-272): the interval
+        # doubles after every var_update_scaler *updates* — i.e. interval
+        # 2^j covers steps [s*(2^j - 1), s*(2^{j+1} - 1)) with s the scaler,
+        # so the variance keeps refreshing (sparsely) for the whole run
+        j = jnp.floor(jnp.log2(fcount / var_update_scaler + 1.0))
+        interval = 2.0**j
+        phase_start = var_update_scaler * (interval - 1.0)
+        update_var = jnp.logical_and(count <= var_freeze_step,
+                                     jnp.mod(fcount - phase_start, interval) < 1.0)
+
+        # 0/1 Adam compresses the *gradient* on non-var-update steps
+        # (zoadam.py:212 grad_onebit); the momentum smooths the sign noise
+        comp = jax.tree_util.tree_map(lambda g, e, se: _compress_leaf(g.astype(jnp.float32), e, se, axis_name),
+                                      grads, state.error, state.server_error)
+        treedef = jax.tree_util.tree_structure(state.mu)
+        leaves = jax.tree_util.tree_leaves(comp, is_leaf=lambda x: isinstance(x, tuple))
+        g_onebit = jax.tree_util.tree_unflatten(treedef, [c[0] for c in leaves])
+        new_err = jax.tree_util.tree_unflatten(treedef, [c[1] for c in leaves])
+        new_serr = jax.tree_util.tree_unflatten(treedef, [c[2] for c in leaves])
+        # post-freeze the reference switches to local raw-grad steps with
+        # interval-synced corrections (zoadam.py:220,243); under SPMD the
+        # sync is the psum that already averaged the grads, so raw grads
+        # are exact there. Uncompressed steps don't consume error feedback.
+        use_raw = jnp.logical_or(update_var, count > var_freeze_step)
+        kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(use_raw, o, n), state.error, new_err)
+        kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(use_raw, o, n), state.server_error, new_serr)
+
+        g_used = jax.tree_util.tree_map(lambda g, gq: jnp.where(use_raw, g.astype(jnp.float32), gq),
+                                        grads, g_onebit)
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, g_used)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(update_var, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
+            state.nu, grads)
+
+        def step_leaf(m, v, p):
+            # reference zoadam applies no bias correction (update =
+            # exp_avg / (sqrt(exp_avg_sq) + eps), zoadam.py:236)
+            upd = m / (jnp.sqrt(v) + eps)
+            if weight_decay > 0 and p is not None:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            return (-learning_rate * upd).astype(p.dtype if p is not None else jnp.float32)
+
+        updates = (jax.tree_util.tree_map(step_leaf, mu, nu, params) if params is not None else
+                   jax.tree_util.tree_map(lambda m, v: step_leaf(m, v, None), mu, nu))
+        return updates, OnebitState(count, mu, nu, kept_err, kept_serr, state.scaling_coeff)
+
+    return optax.GradientTransformation(init, update)
+
+
+def onebit_lamb(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
+                weight_decay: float = 0.0, freeze_step: int = 100, max_coeff: float = 10.0,
+                min_coeff: float = 0.01, axis_name: Optional[str] = None,
+                world: int = 1) -> optax.GradientTransformation:
+    """Reference ``OnebitLamb`` (``onebit/lamb.py``): LAMB during warmup
+    (fresh trust ratios); after the freeze the momentum is compressed and
+    the trust ratio reuses the scaling coefficient captured at the
+    boundary (reference keeps ``scaling_coeff`` per tensor)."""
+
+    def init(params):
+        err, serr = _error_shapes(params, axis_name, world)
+        return OnebitState(jnp.zeros((), jnp.int32), _zeros_like_tree(params), _zeros_like_tree(params),
+                           err, serr, jax.tree_util.tree_map(lambda p: jnp.ones((), jnp.float32), params))
+
+    def update(grads, state, params=None):
+        assert params is not None, "onebit_lamb needs params (trust ratio)"
+        count = state.count + 1
+        in_warmup = count <= freeze_step
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
+            state.nu, grads)
+
+        comp = jax.tree_util.tree_map(lambda m, e, se: _compress_leaf(m, e, se, axis_name),
+                                      mu, state.error, state.server_error)
+        treedef = jax.tree_util.tree_structure(mu)
+        leaves = jax.tree_util.tree_leaves(comp, is_leaf=lambda x: isinstance(x, tuple))
+        dec = jax.tree_util.tree_unflatten(treedef, [c[0] for c in leaves])
+        new_err = jax.tree_util.tree_unflatten(treedef, [c[1] for c in leaves])
+        new_serr = jax.tree_util.tree_unflatten(treedef, [c[2] for c in leaves])
+        used_mu = jax.tree_util.tree_map(lambda m, d: jnp.where(in_warmup, m, d), mu, dec)
+        kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.error, new_err)
+        kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.server_error, new_serr)
+
+        bc1 = 1 - b1**count.astype(jnp.float32)
+        bc2 = 1 - b2**jnp.minimum(count, freeze_step).astype(jnp.float32)
+
+        def lamb_leaf(m, v, p, coeff):
+            upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay > 0:
+                upd = upd + weight_decay * p.astype(jnp.float32)
+            w_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(upd)
+            fresh = jnp.clip(jnp.where(u_norm > 0, w_norm / u_norm, 1.0), min_coeff, max_coeff)
+            fresh = jnp.where(w_norm > 0, fresh, 1.0)
+            used = jnp.where(in_warmup, fresh, coeff)
+            return (-learning_rate * used * upd).astype(p.dtype), used
+
+        out = jax.tree_util.tree_map(lamb_leaf, used_mu, nu, params, state.scaling_coeff)
+        out_leaves = jax.tree_util.tree_leaves(out, is_leaf=lambda x: isinstance(x, tuple))
+        updates = jax.tree_util.tree_unflatten(treedef, [c[0] for c in out_leaves])
+        coeffs = jax.tree_util.tree_unflatten(treedef, [c[1] for c in out_leaves])
+        # momentum buffer takes the decoded value (see onebit_adam)
+        return updates, OnebitState(count, used_mu, nu, kept_err, kept_serr, coeffs)
+
+    return optax.GradientTransformation(init, update)

@@ -48,12 +48,23 @@ def create_optimizer(name: Optional[str], params: Optional[Dict] = None) -> opta
     name = (name or ADAMW_OPTIMIZER).lower()
 
     if name in (ONEBIT_ADAM, ZERO_ONE_ADAM, ONEBIT_LAMB):
-        # The compressed-communication variants change the *gradient
-        # communication*, not the local math; communication compression is
-        # configured at the engine level (quantized collectives).
-        logger.warning(f"{name}: error-compensated compressed communication is handled by the engine's "
-                       "quantized-collective path; using the uncompressed update rule locally")
-        name = ADAM_OPTIMIZER if "adam" in name else LAMB_OPTIMIZER
+        from .fp16.onebit import onebit_adam, onebit_lamb, zero_one_adam
+
+        a = _adam_args(params)
+        common = dict(b1=a["b1"], b2=a["b2"], eps=a["eps"], weight_decay=params.get("weight_decay", 0.0))
+        if name == ONEBIT_ADAM:
+            factory = lambda learning_rate, **kw: onebit_adam(
+                learning_rate, freeze_step=params.get("freeze_step", 100), **kw)
+        elif name == ZERO_ONE_ADAM:
+            factory = lambda learning_rate, **kw: zero_one_adam(
+                learning_rate, var_freeze_step=params.get("var_freeze_step", 100),
+                var_update_scaler=params.get("var_update_scaler", 16), **kw)
+        else:
+            factory = lambda learning_rate, **kw: onebit_lamb(
+                learning_rate, freeze_step=params.get("freeze_step", 100),
+                max_coeff=params.get("max_coeff", 10.0), min_coeff=params.get("min_coeff", 0.01), **kw)
+        return optax.inject_hyperparams(lambda learning_rate: factory(learning_rate, **common))(
+            learning_rate=a["learning_rate"])
 
     if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         a = _adam_args(params)

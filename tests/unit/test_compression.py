@@ -167,6 +167,34 @@ def test_redundancy_clean_permanent():
     assert np.isclose((mlp == 0).mean(), 0.5, atol=0.05)
 
 
+def test_redundancy_clean_uses_target_bits():
+    # start 8 / target 4 with offset 0: permanence must land at 4 bits
+    params = _toy_params()
+    cfg = {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0, "quantization_type": "symmetric"},
+        "different_groups": {"wq": {"params": {"start_bits": 8, "target_bits": 4, "quantization_period": 100},
+                                    "modules": ["attn"]}}}}
+    cleaned = redundancy_clean(params, {"compression_training": cfg})
+    attn = np.asarray(cleaned["layers_0"]["attn"]["kernel"])
+    assert len(np.unique(attn.round(6))) <= 16  # 4-bit levels, not 8-bit
+
+
+def test_per_group_bit_schedules():
+    params = _toy_params()
+    cfg = {"weight_quantization": {
+        "shared_parameters": {"enabled": True, "schedule_offset": 0, "quantization_type": "symmetric"},
+        "different_groups": {
+            "coarse": {"params": {"start_bits": 4, "target_bits": 4}, "modules": ["attn"]},
+            "fine": {"params": {"start_bits": 8, "target_bits": 8}, "modules": ["mlp"]},
+        }}}
+    eng = CompressionEngine(params, cfg)
+    out = eng.apply(params, eng.comp_state())
+    attn_levels = len(np.unique(np.asarray(out["layers_0"]["attn"]["kernel"]).round(6)))
+    mlp_levels = len(np.unique(np.asarray(out["layers_0"]["mlp"]["kernel"]).round(6)))
+    assert attn_levels <= 16       # 4-bit group
+    assert 16 < mlp_levels <= 256  # 8-bit group — NOT forced to the first group's bits
+
+
 def test_student_initialization_layer_reduction():
     teacher = _toy_params()
     student = {
