@@ -325,6 +325,12 @@ class DeepSpeedConfig:
         self.csv_monitor = CSVConfig.from_dict(d.get("csv_monitor", {}))
         self.pipeline = PipelineConfig.from_dict(d.get("pipeline", {}))
         self.mesh = MeshConfig.from_dict(d.get("mesh", mesh_shape or {}))
+        # MiCS sugar (reference runtime/zero/mics.py): mics_shard_size=k IS
+        # the mesh layout {fsdp: k, data: replicas}; size fsdp if unset
+        zcfg = d.get("zero_optimization", {})
+        mics = zcfg.get("mics_shard_size", -1)
+        if mics and mics > 0 and "fsdp" not in d.get("mesh", mesh_shape or {}):
+            self.mesh.fsdp = mics
         self.aio = AIOConfig.from_dict(d.get("aio", {}))
         self.checkpoint_config = CheckpointConfig.from_dict(d.get("checkpoint", {}))
         self.data_types = DataTypesConfig.from_dict(d.get("data_types", {}))

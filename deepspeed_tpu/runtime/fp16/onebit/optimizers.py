@@ -102,12 +102,20 @@ def onebit_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
 
     def update(grads, state, params=None):
         count = state.count + 1
-        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
         in_warmup = count <= freeze_step
+        # warmup is exact Adam: in shard_map mode that needs an explicit
+        # uncompressed allreduce (reference warmup path); momentum in the
+        # compressed phase integrates LOCAL grads — the compression IS the
+        # transport. Variance always builds from the synced grads.
+        g_sync = (jax.tree_util.tree_map(lambda g: jax.lax.pmean(g.astype(jnp.float32), axis_name), grads)
+                  if axis_name is not None else grads)
+        g_for_mu = (jax.tree_util.tree_map(lambda gs, g: jnp.where(in_warmup, gs, g.astype(jnp.float32)),
+                                           g_sync, grads) if axis_name is not None else grads)
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, g_for_mu)
         # variance: frozen after warmup
         nu = jax.tree_util.tree_map(
             lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
-            state.nu, grads)
+            state.nu, g_sync)
 
         def compressed_mu(m, e, se):
             dec, ne, nse = _compress_leaf(m, e, se, axis_name)
@@ -180,20 +188,25 @@ def zero_one_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.99
         g_onebit = jax.tree_util.tree_unflatten(treedef, [c[0] for c in leaves])
         new_err = jax.tree_util.tree_unflatten(treedef, [c[1] for c in leaves])
         new_serr = jax.tree_util.tree_unflatten(treedef, [c[2] for c in leaves])
-        # post-freeze the reference switches to local raw-grad steps with
-        # interval-synced corrections (zoadam.py:220,243); under SPMD the
-        # sync is the psum that already averaged the grads, so raw grads
-        # are exact there. Uncompressed steps don't consume error feedback.
-        use_raw = jnp.logical_or(update_var, count > var_freeze_step)
+        # "raw" (uncompressed) steps: var-update steps always; post-freeze
+        # steps only in engine/SPMD mode, where the psum already averaged
+        # the grads (zoadam.py:220,243 local-step machinery). In shard_map
+        # mode raw steps take an explicit uncompressed allreduce, and the
+        # post-freeze phase keeps compressing — never step unsynced.
+        if axis_name is not None:
+            use_raw = update_var
+            g_raw = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g.astype(jnp.float32), axis_name), grads)
+        else:
+            use_raw = jnp.logical_or(update_var, count > var_freeze_step)
+            g_raw = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(use_raw, o, n), state.error, new_err)
         kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(use_raw, o, n), state.server_error, new_serr)
 
-        g_used = jax.tree_util.tree_map(lambda g, gq: jnp.where(use_raw, g.astype(jnp.float32), gq),
-                                        grads, g_onebit)
+        g_used = jax.tree_util.tree_map(lambda g, gq: jnp.where(use_raw, g, gq), g_raw, g_onebit)
         mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, g_used)
         nu = jax.tree_util.tree_map(
-            lambda v, g: jnp.where(update_var, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
-            state.nu, grads)
+            lambda v, g: jnp.where(update_var, b2 * v + (1 - b2) * jnp.square(g), v),
+            state.nu, g_raw)
 
         def step_leaf(m, v, p):
             # reference zoadam applies no bias correction (update =
@@ -228,10 +241,15 @@ def onebit_lamb(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         assert params is not None, "onebit_lamb needs params (trust ratio)"
         count = state.count + 1
         in_warmup = count <= freeze_step
-        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, grads)
+        # same warmup-sync contract as onebit_adam
+        g_sync = (jax.tree_util.tree_map(lambda g: jax.lax.pmean(g.astype(jnp.float32), axis_name), grads)
+                  if axis_name is not None else grads)
+        g_for_mu = (jax.tree_util.tree_map(lambda gs, g: jnp.where(in_warmup, gs, g.astype(jnp.float32)),
+                                           g_sync, grads) if axis_name is not None else grads)
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, g_for_mu)
         nu = jax.tree_util.tree_map(
             lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
-            state.nu, grads)
+            state.nu, g_sync)
 
         comp = jax.tree_util.tree_map(lambda m, e, se: _compress_leaf(m, e, se, axis_name),
                                       mu, state.error, state.server_error)

@@ -1,0 +1,40 @@
+"""zero.Init — construct a model directly into its sharded layout.
+
+Parity: reference ``zero.Init`` (``partition_parameters.py:783``), which
+patches ``nn.Module.__init__`` so parameters are partitioned at
+construction and no rank ever holds the full model. The JAX equivalent
+needs no patching: ``materialize`` traces the init function abstractly
+(``jax.eval_shape``), plans the ZeRO partition specs, and runs the real
+init *under jit with sharded outputs* — XLA initializes each shard on its
+own device, so peak host/device memory is the sharded footprint.
+"""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from .partition import plan_param_specs, specs_to_shardings
+
+
+class Init:
+
+    def __init__(self, config=None, topology=None, tp_rules=None, mesh=None, **unused_reference_kwargs):
+        from ...parallel.mesh import get_mesh_topology
+
+        self.config = config
+        self.topology = topology or get_mesh_topology()
+        self.tp_rules = tp_rules
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, init_fn: Callable, *args, **kwargs):
+        """Run ``init_fn(*args)`` (e.g. ``model.init(rng, batch)``) with
+        every param born sharded per the ZeRO plan."""
+        shapes = jax.eval_shape(lambda: init_fn(*args, **kwargs))
+        specs = plan_param_specs(shapes, self.config, self.topology, self.tp_rules)
+        shardings = specs_to_shardings(specs, self.topology)
+        return jax.jit(lambda: init_fn(*args, **kwargs), out_shardings=shardings)()

@@ -1,0 +1,34 @@
+"""MiCS — Minimal Communication Sharding (shard-group-scoped ZeRO-3).
+
+Parity: reference ``runtime/zero/mics.py`` (``MiCS_Init`` :64,
+``MiCS_Optimizer`` :357): parameters are sharded only within a small
+"shard group" (typically one node) and replicated across groups, so the
+per-layer allgather stays on fast intra-group links while gradients are
+all-reduced across replica groups.
+
+On a TPU mesh this is not a separate optimizer — it IS the mesh layout:
+``mesh = {data: n_replica_groups, fsdp: shard_group_size}`` with ZeRO-3.
+Params carry ``P(..., 'fsdp')`` (sharded in-group, replicated across
+``data``); XLA's partitioner emits the in-group allgather and the
+cross-group gradient psum the reference implements by hand
+(``mics.py:249`` hierarchical allgather, ``:427`` replica allreduce).
+The ``zero_optimization.mics_shard_size`` config key applies this layout
+automatically (see ``DeepSpeedConfig``); ``MiCS_Init`` is ``zero.Init``
+under that mesh.
+"""
+
+from .init import Init
+
+
+class MiCS_Init(Init):
+    """Sharded construction under a MiCS mesh (reference ``mics.py:64``)."""
+
+
+def validate_mics_mesh(config, topo) -> None:
+    k = config.zero_config.mics_shard_size
+    if k and k > 0:
+        fsdp = topo.axis_size("fsdp")
+        if fsdp != k:
+            raise ValueError(
+                f"mics_shard_size={k} but the mesh fsdp axis is {fsdp}; either drop the explicit mesh "
+                "fsdp setting (MiCS will size it) or make them equal")

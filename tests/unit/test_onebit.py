@@ -119,6 +119,45 @@ def test_size_must_divide():
         run(np.zeros((8, 9), np.float32))
 
 
+def test_reduce_scatter_coalesced():
+    from deepspeed_tpu.runtime.comm.compressed import reduce_scatter_coalesced
+
+    mesh = _mesh()
+    rng = np.random.RandomState(3)
+    a = rng.randn(8, 6).astype(np.float32)  # per-worker tensor pair, 6+3=9 -> pads to 16
+    b = rng.randn(8, 3).astype(np.float32)
+
+    @partial(shard_map, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"))
+    def run(x, y):
+        return reduce_scatter_coalesced([x[0], y[0]], "data")[None]
+
+    out = np.asarray(run(a, b))  # (8, 2): each worker's shard of the padded mean
+    full_mean = np.concatenate([a, b], axis=1).mean(axis=0)
+    padded = np.pad(full_mean, (0, 16 - 9))
+    np.testing.assert_allclose(out.reshape(-1), padded, rtol=1e-5)
+
+
+def test_onebit_adam_warmup_syncs_across_workers():
+    """During warmup every worker must apply the SAME (allreduced) update —
+    regression for unsynced local warmup steps."""
+    mesh = _mesh()
+    opt = onebit_adam(learning_rate=0.1, freeze_step=1000, axis_name="data", world=8)
+    rng = np.random.RandomState(4)
+    per_worker_grads = rng.randn(8, 16).astype(np.float32)
+    params = {"w": jnp.zeros((16,), jnp.float32)}
+    state = opt.init(params)
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(), P(), P("data")), out_specs=P("data"),
+             check_vma=False)
+    def one_step(p, s, g):
+        updates, _ = opt.update({"w": g[0]}, s, p)
+        return updates["w"][None]
+
+    ups = np.asarray(one_step(params, state, per_worker_grads))
+    for w in range(1, 8):
+        np.testing.assert_allclose(ups[0], ups[w], rtol=1e-6)
+
+
 # -------------------- optimizers --------------------
 def _train_quadratic(opt, steps=200, seed=0):
     """Minimize ||Aw - b||^2; returns final loss."""

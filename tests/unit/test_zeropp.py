@@ -1,0 +1,148 @@
+"""ZeRO++ (qwZ/qgZ/hpZ) and MiCS tests.
+
+Mirrors reference ``tests/unit/runtime/zero/test_zeropp.py`` (train with
+hpZ/qwZ/qgZ enabled, assert loss sanity) and ``tests/unit/checkpoint/
+test_mics_optimizer.py``. The strongest oracle here: the ZeRO++ manual
+step must track the GSPMD baseline's loss trajectory closely (quantized
+wire formats are lossy but error-compensated / fine-grained).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, gpt2_tiny
+from deepspeed_tpu.runtime.dataloader import RepeatingLoader
+
+
+def _engine(zero_extra=None, mesh=None, lr=1e-2, seed=42):
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": lr}},
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0, **(zero_extra or {})},
+        "mesh": mesh or {"data": 2, "fsdp": 4},
+        "steps_per_print": 1000,
+    }
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(seed), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    return engine
+
+
+def _train(engine, steps=5, seed=0):
+    rng = np.random.RandomState(seed)
+    data = [{"input_ids": rng.randint(0, 1024, size=(16,)).astype(np.int32)} for _ in range(16)]
+    it = RepeatingLoader(engine.deepspeed_io(data))
+    return [float(engine.train_batch(it)) for _ in range(steps)]
+
+
+def test_zeropp_applicability():
+    from deepspeed_tpu.runtime.zero.zeropp import zeropp_applicable
+
+    eng = _engine()  # no zero++ knobs
+    ok, reason = zeropp_applicable(eng.config, eng.topology)
+    assert not ok and "no ZeRO++" in reason
+    eng2 = _engine(zero_extra={"zero_quantized_weights": True})
+    ok, _ = zeropp_applicable(eng2.config, eng2.topology)
+    assert ok
+
+
+def test_qwz_matches_baseline():
+    base = _train(_engine())
+    qwz = _train(_engine(zero_extra={"zero_quantized_weights": True}))
+    assert all(np.isfinite(l) for l in qwz)
+    assert qwz[-1] < qwz[0]
+    # int8 group-quantized weights: trajectories stay close
+    np.testing.assert_allclose(qwz[0], base[0], rtol=0.02)
+    assert abs(qwz[-1] - base[-1]) < 0.5
+
+
+def test_qgz_matches_baseline():
+    base = _train(_engine())
+    qgz = _train(_engine(zero_extra={"zero_quantized_gradients": True}))
+    assert all(np.isfinite(l) for l in qgz)
+    assert qgz[-1] < qgz[0]
+    assert abs(qgz[-1] - base[-1]) < 0.5
+
+
+def test_hpz_exact_vs_baseline():
+    # hpZ changes only WHERE the backward regather reads from — the math
+    # is exact, so the trajectory must match the GSPMD baseline tightly
+    base = _train(_engine())
+    hpz = _train(_engine(zero_extra={"zero_hpz_partition_size": 2}))
+    np.testing.assert_allclose(hpz, base, rtol=5e-3)
+
+
+def test_all_three_combined():
+    losses = _train(_engine(zero_extra={"zero_quantized_weights": True, "zero_quantized_gradients": True,
+                                        "zero_hpz_partition_size": 2}))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_hpz_must_divide_fsdp():
+    with pytest.raises(ValueError):
+        _engine(zero_extra={"zero_hpz_partition_size": 3})  # fsdp=4
+
+
+def test_zeropp_falls_back_with_tensor_axis():
+    # tensor axis > 1: manual path not applicable; engine falls back and
+    # still trains
+    eng = _engine(zero_extra={"zero_quantized_weights": True}, mesh={"data": 2, "fsdp": 2, "tensor": 2})
+    losses = _train(eng, steps=3)
+    assert all(np.isfinite(l) for l in losses)
+
+
+# -------------------- MiCS --------------------
+def test_mics_mesh_sugar_and_sharding():
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "mics_shard_size": 4, "stage3_param_persistence_threshold": 0},
+        "steps_per_print": 1000,
+    }
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+    # mesh sized from mics_shard_size: fsdp=4, data absorbs the rest (2)
+    assert engine.topology.axis_size("fsdp") == 4
+    assert engine.topology.axis_size("data") == 2
+    # params sharded 4-way within the shard group, replicated across groups
+    leaf = jax.tree_util.tree_leaves(engine.params)[-1]
+    assert "fsdp" in str(leaf.sharding.spec)
+    losses = _train(engine, steps=3)
+    assert losses[-1] < losses[0]
+
+
+def test_mics_mesh_conflict_raises():
+    cfg = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "mics_shard_size": 4},
+        "mesh": {"data": 4, "fsdp": 2},
+        "steps_per_print": 1000,
+    }
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    with pytest.raises(ValueError):
+        deepspeed_tpu.initialize(model=model, model_parameters=params, config=cfg)
+
+
+def test_zero_init_materializes_sharded():
+    from deepspeed_tpu.runtime.config import DeepSpeedConfig
+    from deepspeed_tpu.runtime.zero import Init
+
+    config = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                              "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+                              "mesh": {"data": 2, "fsdp": 4}})
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+
+    topo = initialize_mesh(config.mesh, force=True)
+    model = CausalLM(gpt2_tiny())
+    batch = {"input_ids": np.zeros((1, 16), np.int32)}
+    with Init(config=config, topology=topo) as ctx:
+        params = ctx.materialize(model.init, jax.random.PRNGKey(0), batch)
+    big_leaves = [l for l in jax.tree_util.tree_leaves(params) if l.size > 4]
+    assert any("fsdp" in str(l.sharding.spec) for l in big_leaves)
