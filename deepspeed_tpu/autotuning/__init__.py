@@ -1,0 +1,4 @@
+from .autotuner import Autotuner, autotune
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
+
+__all__ = ["Autotuner", "autotune", "GridSearchTuner", "RandomTuner", "ModelBasedTuner"]

@@ -1,0 +1,184 @@
+"""Autotuner: search ZeRO stage x micro-batch space for best throughput.
+
+Parity: reference ``autotuning/autotuner.py`` (``Autotuner`` :42,
+``_generate_experiments`` :304, ``tune`` :404, ``model_info_profile_run``
+:663, best-config selection :714). The reference launches every
+experiment as a separate multi-process job via the resource manager; the
+TPU-native autotuner runs trials IN PROCESS — an engine under a candidate
+config is just another jit compilation on the same mesh, so a trial is
+build-engine -> few steps -> read samples/sec -> free. Failures (OOM,
+compile errors) score ``None`` and prune that region of the space.
+"""
+
+import gc
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..utils.logging import logger
+from .tuner import BaseTuner, GridSearchTuner, ModelBasedTuner, RandomTuner
+
+TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner, "model_based": ModelBasedTuner}
+DEFAULT_TUNING_SPACE_ZERO_STAGES = [0, 1, 2, 3]
+
+
+def _deep_update(base: Dict, override: Dict) -> Dict:
+    out = json.loads(json.dumps(base))
+    for k, v in override.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_update(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+class Autotuner:
+
+    def __init__(self,
+                 model_factory: Callable[[], Any],
+                 base_config: Dict,
+                 train_batches: Sequence,
+                 params_factory: Optional[Callable[[], Any]] = None,
+                 metric: str = "throughput",
+                 steps_per_trial: int = 4,
+                 warmup_steps: int = 1):
+        """``model_factory()`` returns a fresh model; ``train_batches`` is a
+        list of batches each trial iterates over (repeated as needed)."""
+        self.model_factory = model_factory
+        self.params_factory = params_factory
+        self.base_config = dict(base_config)
+        self.train_batches = list(train_batches)
+        self.at_cfg = base_config.get("autotuning", {})
+        self.metric = self.at_cfg.get("metric", metric)
+        self.steps_per_trial = steps_per_trial
+        self.warmup_steps = warmup_steps
+        self.records: List[Dict] = []
+
+    # ------------------------------------------------------------------
+    def model_info_profile_run(self) -> Dict:
+        """Param count + per-step FLOPs of the model under the base config
+        (reference :663 runs a whole profiling job for this)."""
+        import jax
+
+        from ..profiling.flops_profiler import get_model_profile
+
+        model = self.model_factory()
+        flops, macs, n_params = get_model_profile(model=model, args=(self.train_batches[0],),
+                                                  print_profile=False, as_string=False)
+        return {"num_params": int(n_params), "flops_per_step": int(flops), "macs": int(macs)}
+
+    def _generate_experiments(self, stages: Optional[List[int]] = None,
+                              micro_batches: Optional[List[int]] = None) -> List[Dict]:
+        stages = stages if stages is not None else DEFAULT_TUNING_SPACE_ZERO_STAGES
+        if micro_batches is None:
+            base_mb = self.base_config.get("train_micro_batch_size_per_gpu", 1)
+            n = self.at_cfg.get("num_tuning_micro_batch_sizes", 3)
+            lo = self.at_cfg.get("min_train_micro_batch_size_per_gpu", 1)
+            hi = self.at_cfg.get("max_train_micro_batch_size_per_gpu", None)
+            micro_batches = sorted({max(lo, base_mb * (2**i)) for i in range(n)})
+            if hi:
+                micro_batches = [m for m in micro_batches if m <= hi] or [lo]
+        exps = []
+        for stage in stages:
+            for mb in micro_batches:
+                exps.append({
+                    "zero_optimization": {"stage": stage},
+                    "train_micro_batch_size_per_gpu": int(mb),
+                })
+        return exps
+
+    def run_experiment(self, exp: Dict) -> Optional[float]:
+        """One in-process trial; returns the metric value or None on
+        failure (the reference's failed-experiment path)."""
+        import jax
+
+        import deepspeed_tpu
+
+        config = _deep_update(self.base_config, exp)
+        config.pop("autotuning", None)
+        engine = None
+        try:
+            model = self.model_factory()
+            params = self.params_factory() if self.params_factory else model.init(
+                jax.random.PRNGKey(0), self.train_batches[0])
+            engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
+            mb = config.get("train_micro_batch_size_per_gpu", 1)
+            dp = engine.topology.data_parallel_size
+
+            def batch_at(i):
+                b = self.train_batches[i % len(self.train_batches)]
+                leaves = jax.tree_util.tree_leaves(b)
+                need = mb * dp
+                if leaves and leaves[0].shape[0] != need:
+                    reps = -(-need // leaves[0].shape[0])
+                    return jax.tree_util.tree_map(lambda x: np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need], b)
+                return b
+
+            for i in range(self.warmup_steps):
+                engine.forward(batch_at(i))
+                engine.backward()
+                engine.step()
+            t0 = time.perf_counter()
+            for i in range(self.steps_per_trial):
+                engine.forward(batch_at(self.warmup_steps + i))
+                engine.backward()
+                engine.step()
+            import jax.numpy as jnp
+
+            (jnp.zeros(()) + 0).block_until_ready()
+            dt = time.perf_counter() - t0
+            samples = self.steps_per_trial * mb * dp * engine.gradient_accumulation_steps
+            if self.metric == "latency":
+                return -dt / self.steps_per_trial
+            return samples / dt  # throughput (samples/sec); also the 'flops' proxy
+        except Exception as e:  # noqa: BLE001 — OOM/compile failures score None
+            logger.warning(f"autotuning experiment {exp} failed: {type(e).__name__}: {e}")
+            return None
+        finally:
+            del engine
+            gc.collect()
+
+    def tune(self, stages: Optional[List[int]] = None, micro_batches: Optional[List[int]] = None) -> Dict:
+        """Run the search; returns the best merged config (reference :404)."""
+        exps = self._generate_experiments(stages, micro_batches)
+        tuner_type = self.at_cfg.get("tuner_type", "gridsearch")
+        tuner: BaseTuner = TUNERS[tuner_type](exps, metric=self.metric)
+        early_stop = self.at_cfg.get("tuner_early_stopping", 5)
+        max_trials = self.at_cfg.get("tuner_num_trials", 50)
+        n_run = 0
+        while n_run < max_trials:
+            batch = tuner.next_batch(1)
+            if not batch:
+                break
+            exp = batch[0]
+            val = self.run_experiment(exp)
+            tuner.record(exp, val)
+            self.records.append({"exp": exp, self.metric: val})
+            logger.info(f"autotuning [{n_run + 1}/{min(max_trials, len(exps))}] {exp} -> {val}")
+            n_run += 1
+            if tuner.should_stop(early_stop):
+                logger.info("autotuning early stop: no improvement")
+                break
+        best_exp, best_val = tuner.best()
+        if best_exp is None:
+            raise RuntimeError("autotuning: every experiment failed")
+        result = _deep_update(self.base_config, best_exp)
+        result.pop("autotuning", None)
+        logger.info(f"autotuning best ({self.metric}={best_val:.2f}): {best_exp}")
+        return result
+
+    def write_results(self, results_dir: Optional[str] = None) -> str:
+        d = results_dir or self.at_cfg.get("results_dir", "autotuning_results")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, "autotuning_results.json")
+        with open(path, "w") as f:
+            json.dump(self.records, f, indent=2, default=str)
+        return path
+
+
+def autotune(model_factory, base_config, train_batches, **kwargs) -> Dict:
+    """One-call API: returns the best config found."""
+    return Autotuner(model_factory, base_config, train_batches, **kwargs).tune()

@@ -1,0 +1,87 @@
+"""Experiment-selection strategies.
+
+Parity: reference ``autotuning/tuner/`` (``GridSearchTuner``/
+``RandomTuner`` in ``index_based_tuner.py``, ``ModelBasedTuner`` +
+``cost_model.py``). A tuner proposes the next experiment from a finite
+space given the results so far; the model-based tuner fits the observed
+(micro_batch -> metric) curve and prunes configs predicted to be worse
+than the incumbent.
+"""
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class BaseTuner:
+
+    def __init__(self, exps: List[Dict], metric: str = "throughput", seed: int = 1234):
+        self.all_exps = list(exps)
+        self.metric = metric
+        self.results: List[Tuple[Dict, Optional[float]]] = []
+        self.rng = random.Random(seed)
+
+    @property
+    def remaining(self) -> List[Dict]:
+        done = {id(e) for e, _ in self.results}
+        return [e for e in self.all_exps if id(e) not in done]
+
+    def next_batch(self, n: int = 1) -> List[Dict]:
+        raise NotImplementedError
+
+    def record(self, exp: Dict, metric_val: Optional[float]) -> None:
+        """metric_val None = failed run (OOM/compile error)."""
+        self.results.append((exp, metric_val))
+
+    def best(self) -> Tuple[Optional[Dict], float]:
+        ok = [(e, v) for e, v in self.results if v is not None]
+        if not ok:
+            return None, 0.0
+        return max(ok, key=lambda t: t[1])
+
+    def should_stop(self, early_stopping: int) -> bool:
+        """Stop once `early_stopping` runs have passed without a new best."""
+        if early_stopping <= 0:
+            return False
+        ok = [(i, v) for i, (_, v) in enumerate(self.results) if v is not None]
+        if not ok:
+            return False
+        best_i = max(ok, key=lambda t: t[1])[0]
+        return len(self.results) - 1 - best_i >= early_stopping
+
+
+class GridSearchTuner(BaseTuner):
+
+    def next_batch(self, n: int = 1) -> List[Dict]:
+        return self.remaining[:n]
+
+
+class RandomTuner(BaseTuner):
+
+    def next_batch(self, n: int = 1) -> List[Dict]:
+        rem = self.remaining
+        return self.rng.sample(rem, min(n, len(rem)))
+
+
+class ModelBasedTuner(BaseTuner):
+    """Greedy surrogate: assume the metric is unimodal in the micro-batch
+    size within a zero stage (the reference cost model's core assumption);
+    explore stages round-robin, and within a stage propose the untried
+    micro-batch adjacent to the best observed one."""
+
+    @staticmethod
+    def _key(exp: Dict) -> Tuple:
+        z = exp.get("zero_optimization", {}).get("stage", 0)
+        return (z, exp.get("train_micro_batch_size_per_gpu", 1))
+
+    def next_batch(self, n: int = 1) -> List[Dict]:
+        rem = sorted(self.remaining, key=self._key)
+        if not rem:
+            return []
+        ok = [(e, v) for e, v in self.results if v is not None]
+        if not ok:
+            return rem[:n]
+        best_exp, _ = max(ok, key=lambda t: t[1])
+        bz, bm = self._key(best_exp)
+        # prefer same-stage neighbors of the incumbent, then other stages
+        rem.sort(key=lambda e: (self._key(e)[0] != bz, abs(self._key(e)[1] - bm)))
+        return rem[:n]

@@ -1,0 +1,4 @@
+from .quantization import (QuantizationContext, QuantizedParam, dequantize_param, dequantize_tree,
+                           quantize_model_params)
+
+__all__ = ["QuantizedParam", "QuantizationContext", "quantize_model_params", "dequantize_tree", "dequantize_param"]

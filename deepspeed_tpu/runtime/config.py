@@ -326,11 +326,11 @@ class DeepSpeedConfig:
         self.pipeline = PipelineConfig.from_dict(d.get("pipeline", {}))
         self.mesh = MeshConfig.from_dict(d.get("mesh", mesh_shape or {}))
         # MiCS sugar (reference runtime/zero/mics.py): mics_shard_size=k IS
-        # the mesh layout {fsdp: k, data: replicas}; size fsdp if unset
-        zcfg = d.get("zero_optimization", {})
-        mics = zcfg.get("mics_shard_size", -1)
-        if mics and mics > 0 and "fsdp" not in d.get("mesh", mesh_shape or {}):
-            self.mesh.fsdp = mics
+        # the mesh layout {fsdp: k, data: replicas}; size fsdp if unset.
+        # (zero_config is parsed below; peek with the validated model here)
+        _mics = ZeroConfig.from_dict(d.get("zero_optimization", {})).mics_shard_size
+        if _mics > 0 and "fsdp" not in d.get("mesh", mesh_shape or {}):
+            self.mesh.fsdp = int(_mics)
         self.aio = AIOConfig.from_dict(d.get("aio", {}))
         self.checkpoint_config = CheckpointConfig.from_dict(d.get("checkpoint", {}))
         self.data_types = DataTypesConfig.from_dict(d.get("data_types", {}))

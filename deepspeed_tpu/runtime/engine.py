@@ -255,23 +255,17 @@ class DeepSpeedEngine:
                 params32, batch, rng, scale, comp_state)
             return raw_loss, grads
 
-        from .zero.zeropp import build_zeropp_fwd_bwd, zeropp_applicable
+        from .zero.zeropp import build_zeropp_fwd_bwd, zeropp_applicable, zeropp_requested
 
         use_zeropp, zeropp_reason = zeropp_applicable(self.config, self.topology)
         if use_zeropp and comp is not None:
             use_zeropp = False
             zeropp_reason = "compression_training and ZeRO++ manual path are mutually exclusive"
-        zeropp_requested = (self.config.zero_config.zero_quantized_weights
-                            or self.config.zero_config.zero_quantized_gradients
-                            or self.config.zero_config.zero_hpz_partition_size > 1)
-        if zeropp_requested and not use_zeropp:
+        if zeropp_requested(self.config) and not use_zeropp:
             log_dist(f"ZeRO++ requested but falling back to GSPMD path: {zeropp_reason}", ranks=[0])
         if use_zeropp:
-            baxes = self.topology.batch_axes
-            self._fwd_bwd = build_zeropp_fwd_bwd(
-                loss_fn, self.param_specs, self.grad_specs,
-                jax.sharding.PartitionSpec(baxes if len(baxes) > 1 else baxes[0]),
-                self.topology, self.config, compute_dtype)
+            self._fwd_bwd = build_zeropp_fwd_bwd(loss_fn, self.param_specs, self.grad_specs,
+                                                 self.topology, self.config, compute_dtype)
         elif comp is None:
             self._fwd_bwd = jax.jit(lambda p, b, r, s: fwd_bwd(p, b, r, s, None),
                                     out_shardings=(None, self.grad_shardings))

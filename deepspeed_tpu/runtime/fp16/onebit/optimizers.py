@@ -106,16 +106,22 @@ def onebit_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         # warmup is exact Adam: in shard_map mode that needs an explicit
         # uncompressed allreduce (reference warmup path); momentum in the
         # compressed phase integrates LOCAL grads — the compression IS the
-        # transport. Variance always builds from the synced grads.
-        g_sync = (jax.tree_util.tree_map(lambda g: jax.lax.pmean(g.astype(jnp.float32), axis_name), grads)
-                  if axis_name is not None else grads)
-        g_for_mu = (jax.tree_util.tree_map(lambda gs, g: jnp.where(in_warmup, gs, g.astype(jnp.float32)),
-                                           g_sync, grads) if axis_name is not None else grads)
-        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, g_for_mu)
-        # variance: frozen after warmup
+        # transport. The allreduce sits under lax.cond so the steady state
+        # pays only the int8 exchange (in_warmup is device-uniform).
+        grads_f32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        if axis_name is not None:
+            g_for_mu = jax.lax.cond(
+                in_warmup,
+                lambda g: jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), g),
+                lambda g: g, grads_f32)
+        else:
+            g_for_mu = grads_f32
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, g_for_mu)
+        # variance: frozen after warmup (so g_for_mu — synced during
+        # warmup, the only time nu updates — is the right input)
         nu = jax.tree_util.tree_map(
-            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
-            state.nu, g_sync)
+            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g), v),
+            state.nu, g_for_mu)
 
         def compressed_mu(m, e, se):
             dec, ne, nse = _compress_leaf(m, e, se, axis_name)
@@ -193,12 +199,17 @@ def zero_one_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.99
         # the grads (zoadam.py:220,243 local-step machinery). In shard_map
         # mode raw steps take an explicit uncompressed allreduce, and the
         # post-freeze phase keeps compressing — never step unsynced.
+        grads_f32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
         if axis_name is not None:
             use_raw = update_var
-            g_raw = jax.tree_util.tree_map(lambda g: jax.lax.pmean(g.astype(jnp.float32), axis_name), grads)
+            # allreduce only on the (sparse) var-update steps
+            g_raw = jax.lax.cond(
+                update_var,
+                lambda g: jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), g),
+                lambda g: g, grads_f32)
         else:
             use_raw = jnp.logical_or(update_var, count > var_freeze_step)
-            g_raw = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+            g_raw = grads_f32
         kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(use_raw, o, n), state.error, new_err)
         kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(use_raw, o, n), state.server_error, new_serr)
 
@@ -241,15 +252,19 @@ def onebit_lamb(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         assert params is not None, "onebit_lamb needs params (trust ratio)"
         count = state.count + 1
         in_warmup = count <= freeze_step
-        # same warmup-sync contract as onebit_adam
-        g_sync = (jax.tree_util.tree_map(lambda g: jax.lax.pmean(g.astype(jnp.float32), axis_name), grads)
-                  if axis_name is not None else grads)
-        g_for_mu = (jax.tree_util.tree_map(lambda gs, g: jnp.where(in_warmup, gs, g.astype(jnp.float32)),
-                                           g_sync, grads) if axis_name is not None else grads)
-        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), state.mu, g_for_mu)
+        # same warmup-sync contract as onebit_adam (cond-gated allreduce)
+        grads_f32 = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+        if axis_name is not None:
+            g_for_mu = jax.lax.cond(
+                in_warmup,
+                lambda g: jax.tree_util.tree_map(lambda x: jax.lax.pmean(x, axis_name), g),
+                lambda g: g, grads_f32)
+        else:
+            g_for_mu = grads_f32
+        mu = jax.tree_util.tree_map(lambda m, g: b1 * m + (1 - b1) * g, state.mu, g_for_mu)
         nu = jax.tree_util.tree_map(
-            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g.astype(jnp.float32)), v),
-            state.nu, g_sync)
+            lambda v, g: jnp.where(in_warmup, b2 * v + (1 - b2) * jnp.square(g), v),
+            state.nu, g_for_mu)
 
         comp = jax.tree_util.tree_map(lambda m, e, se: _compress_leaf(m, e, se, axis_name),
                                       mu, state.error, state.server_error)

@@ -20,7 +20,12 @@ class Init:
 
     def __init__(self, config=None, topology=None, tp_rules=None, mesh=None, **unused_reference_kwargs):
         from ...parallel.mesh import get_mesh_topology
+        from ..config import DeepSpeedConfig
 
+        if config is None:
+            # bare `with zero.Init():` — default to stage-3 sharding over
+            # whatever mesh is active (the reference's default semantics)
+            config = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1, "zero_optimization": {"stage": 3}})
         self.config = config
         self.topology = topology or get_mesh_topology()
         self.tp_rules = tp_rules

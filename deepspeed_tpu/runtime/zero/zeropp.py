@@ -40,10 +40,14 @@ from ..comm.compressed import all_to_all_quant_reduce
 _GROUP = 2048  # elements per quantization scale
 
 
+def zeropp_requested(config) -> bool:
+    z = config.zero_config
+    return bool(z.zero_quantized_weights or z.zero_quantized_gradients or z.zero_hpz_partition_size > 1)
+
+
 def zeropp_applicable(config, topo) -> Tuple[bool, str]:
     z = config.zero_config
-    wanted = (z.zero_quantized_weights or z.zero_quantized_gradients or z.zero_hpz_partition_size > 1)
-    if not wanted:
+    if not zeropp_requested(config):
         return False, "no ZeRO++ feature enabled"
     for axis in ("tensor", "pipe", "seq", "context", "expert"):
         if topo.axis_size(axis) > 1:
@@ -130,12 +134,14 @@ def _hpz_groups(fsdp_size: int, k: int):
     return [list(range(i, i + k)) for i in range(0, fsdp_size, k)]
 
 
-def build_zeropp_fwd_bwd(loss_fn: Callable, param_specs, grad_specs, batch_specs_tree, topo, config,
+def build_zeropp_fwd_bwd(loss_fn: Callable, param_specs, grad_specs, topo, config,
                          compute_dtype) -> Callable:
     """Manual-SPMD (fwd+bwd) step with explicit, compressible collectives.
 
     Returns ``fn(params32, batch, rng, scale) -> (raw_loss, grads)`` with
-    the same contract as the engine's GSPMD ``fwd_bwd``.
+    the same contract as the engine's GSPMD ``fwd_bwd``. The shard_map is
+    specialized (and cached) per batch pytree structure, using the same
+    ``batch_specs`` planner as the GSPMD path (scalar leaves replicated).
     """
     z = config.zero_config
     qwz = z.zero_quantized_weights
@@ -217,13 +223,22 @@ def build_zeropp_fwd_bwd(loss_fn: Callable, param_specs, grad_specs, batch_specs
         loss_avg = jax.lax.pmean(raw_loss, ("data", "fsdp"))
         return loss_avg, grads
 
-    # at stage 3 grad specs coincide with param specs: the fsdp-sharded
-    # local grads tile back into the same global layout
-    grad_out_specs = grad_specs
+    # local grads have exactly the PARAM layout: fsdp shards for sharded
+    # leaves, replicated for persistence-threshold leaves (grad_specs may
+    # shard the latter further — the engine reshards on first use)
+    from .partition import batch_specs as plan_batch_specs
 
-    stepped = shard_map(
-        local_step, mesh=topo.mesh,
-        in_specs=(param_specs, batch_specs_tree, P(), P()),
-        out_specs=(P(), grad_out_specs),
-        check_vma=False)
-    return jax.jit(stepped)
+    cache: Dict[Any, Callable] = {}
+
+    def stepped(params32, batch, rng, scale):
+        treedef = jax.tree_util.tree_structure(batch)
+        if treedef not in cache:
+            bspecs = plan_batch_specs(batch, topo)
+            cache[treedef] = jax.jit(shard_map(
+                local_step, mesh=topo.mesh,
+                in_specs=(param_specs, bspecs, P(), P()),
+                out_specs=(P(), param_specs),
+                check_vma=False))
+        return cache[treedef](params32, batch, rng, scale)
+
+    return stepped

@@ -1,0 +1,135 @@
+"""Autotuner tests.
+
+Mirrors reference ``tests/unit/autotuning/test_autotuning.py``: experiment
+generation over the (stage x micro-batch) space, tuner proposal/early-stop
+logic with stubbed results, model-info profiling, and a real in-process
+tune over a tiny space.
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner, GridSearchTuner, ModelBasedTuner, RandomTuner
+from deepspeed_tpu.autotuning.autotuner import _deep_update
+
+
+def _exps():
+    return [{"zero_optimization": {"stage": s}, "train_micro_batch_size_per_gpu": m}
+            for s in (0, 1) for m in (1, 2, 4)]
+
+
+def test_deep_update():
+    base = {"a": {"b": 1, "c": 2}, "d": 3}
+    out = _deep_update(base, {"a": {"b": 9}, "e": 5})
+    assert out == {"a": {"b": 9, "c": 2}, "d": 3, "e": 5}
+    assert base["a"]["b"] == 1  # no mutation
+
+
+def test_gridsearch_order_and_best():
+    t = GridSearchTuner(_exps())
+    seen = []
+    for val in [1.0, 3.0, 2.0, None, 5.0, 4.0]:
+        exp = t.next_batch(1)[0]
+        seen.append(exp)
+        t.record(exp, val)
+    assert t.next_batch(1) == []
+    best, v = t.best()
+    assert v == 5.0 and best is seen[4]
+
+
+def test_random_tuner_covers_space():
+    t = RandomTuner(_exps(), seed=0)
+    picked = []
+    while True:
+        b = t.next_batch(1)
+        if not b:
+            break
+        picked.append(b[0])
+        t.record(b[0], 1.0)
+    assert len(picked) == 6
+
+
+def test_model_based_tuner_prefers_neighbors():
+    t = ModelBasedTuner(_exps())
+    first = t.next_batch(1)[0]
+    t.record(first, 10.0)  # stage 0, mb 1 is incumbent
+    nxt = t.next_batch(1)[0]
+    # same stage, nearest untried micro-batch
+    assert nxt["zero_optimization"]["stage"] == first["zero_optimization"]["stage"]
+    assert nxt["train_micro_batch_size_per_gpu"] == 2
+
+
+def test_early_stopping():
+    t = GridSearchTuner(_exps())
+    exps = iter(_exps())
+    t.record(next(exps), 10.0)
+    for _ in range(3):
+        t.record(next(exps), 1.0)
+    assert t.should_stop(3)
+    assert not t.should_stop(4)
+    assert not t.should_stop(0)
+
+
+def _tiny_setup():
+    import jax
+
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+
+    rng = np.random.RandomState(0)
+    batches = [{"input_ids": rng.randint(0, 1024, size=(8, 16)).astype(np.int32)} for _ in range(2)]
+    return (lambda: CausalLM(gpt2_tiny())), batches
+
+
+def test_experiment_generation_defaults():
+    factory, batches = _tiny_setup()
+    at = Autotuner(factory, {"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam"}}, batches)
+    exps = at._generate_experiments()
+    stages = {e["zero_optimization"]["stage"] for e in exps}
+    mbs = {e["train_micro_batch_size_per_gpu"] for e in exps}
+    assert stages == {0, 1, 2, 3}
+    assert mbs == {1, 2, 4}
+
+
+def test_model_info_profile_run():
+    factory, batches = _tiny_setup()
+    at = Autotuner(factory, {"train_micro_batch_size_per_gpu": 1}, batches)
+    info = at.model_info_profile_run()
+    assert info["num_params"] > 0 and info["flops_per_step"] > 0
+
+
+def test_tune_end_to_end(tmp_path):
+    factory, batches = _tiny_setup()
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+        "autotuning": {"enabled": True, "tuner_type": "gridsearch", "results_dir": str(tmp_path)},
+    }
+    at = Autotuner(factory, base, batches, steps_per_trial=2, warmup_steps=1)
+    best = at.tune(stages=[0, 1], micro_batches=[1, 2])
+    assert best["zero_optimization"]["stage"] in (0, 1)
+    assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert "autotuning" not in best
+    assert len(at.records) == 4
+    assert all(r["throughput"] is not None for r in at.records)
+    path = at.write_results()
+    assert tmp_path.joinpath("autotuning_results.json").exists()
+
+
+def test_failed_experiments_pruned():
+    factory, batches = _tiny_setup()
+    at = Autotuner(factory, {"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam"}}, batches)
+    calls = []
+
+    def fake_run(exp):
+        calls.append(exp)
+        return None if exp["zero_optimization"]["stage"] == 0 else 7.0
+
+    at.run_experiment = fake_run
+    best = at.tune(stages=[0, 1], micro_batches=[1])
+    assert best["zero_optimization"]["stage"] == 1
+
+    at2 = Autotuner(factory, {"train_micro_batch_size_per_gpu": 1}, batches)
+    at2.run_experiment = lambda exp: None
+    with pytest.raises(RuntimeError):
+        at2.tune(stages=[0], micro_batches=[1])
