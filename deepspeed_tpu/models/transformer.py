@@ -208,7 +208,7 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None):
+    def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None, return_hidden=False):
         cfg = self.cfg
         B, S = input_ids.shape
         if positions is None:
@@ -235,6 +235,10 @@ class Transformer(nn.Module):
                     x = blk(x, positions, None, segment_ids)
 
         x = make_norm(cfg)(x)
+        if return_hidden:
+            # loss path: the head projection happens inside the fused CE
+            # (ops/fused_ce.py) so full (B,S,V) logits never hit HBM
+            return (x, new_caches) if kv_caches is not None else x
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(cfg.dtype))
         else:
@@ -288,18 +292,29 @@ class CausalLM:
         return self.module.apply({"params": params}, input_ids, **kwargs)
 
     def loss_fn(self, params, batch, rng=None) -> jnp.ndarray:
+        from ..ops.fused_ce import fused_cross_entropy
+
         input_ids = batch["input_ids"]
         if self.cfg.moe_num_experts > 0:
-            logits, mods = self.module.apply({"params": params}, input_ids, mutable=["losses", "intermediates"])
+            hidden, mods = self.module.apply({"params": params}, input_ids, return_hidden=True,
+                                             mutable=["losses", "intermediates"])
             aux_leaves = jax.tree_util.tree_leaves(mods.get("losses", {}))
             aux = sum(jnp.sum(l) for l in aux_leaves) if aux_leaves else 0.0
         else:
-            logits = self.apply(params, input_ids)
+            hidden = self.apply(params, input_ids, return_hidden=True)
             aux = 0.0
-        if "labels" in batch:
-            ce = cross_entropy_loss(logits, batch["labels"])
+        if self.cfg.tie_embeddings:
+            w, vd = params["wte"].astype(self.cfg.dtype), True
         else:
-            ce = cross_entropy_loss(logits[:, :-1], input_ids[:, 1:])
+            w, vd = params["lm_head"]["kernel"].astype(self.cfg.dtype), False
+        if "labels" in batch:
+            labels = batch["labels"]
+        else:
+            # shift left; keep S intact (last position ignored) so the fused
+            # CE's sequence chunking stays aligned
+            labels = jnp.concatenate(
+                [input_ids[:, 1:], jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)], axis=1)
+        ce = fused_cross_entropy(hidden, w, labels, vd_layout=vd)
         return ce + self.cfg.moe_aux_loss_coef * aux
 
     def to_pipeline(self, num_stages: int, params=None, rng=None, example_batch=None):
@@ -355,12 +370,16 @@ class CausalLM:
             return x
 
         def head_loss_fn(hp, x, labels_or_ids, labels_are_shifted: bool):
+            from ..ops.fused_ce import fused_cross_entropy
+
             norm = make_norm(cfg)
             x = norm.apply({"params": hp[norm_key[0]]}, x) if norm_key else x
-            logits = jnp.einsum("bsd,dv->bsv", x, hp["lm_head"]["kernel"].astype(cfg.dtype)).astype(jnp.float32)
             if labels_are_shifted:
-                return cross_entropy_loss(logits, labels_or_ids)
-            return cross_entropy_loss(logits[:, :-1], labels_or_ids[:, 1:])
+                labels = labels_or_ids
+            else:
+                ids = labels_or_ids
+                labels = jnp.concatenate([ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
+            return fused_cross_entropy(x, hp["lm_head"]["kernel"].astype(cfg.dtype), labels, vd_layout=False)
 
         base_rules = self.partition_rules()
         rules = [(("stages",) + key, P(*(("pipe",) + tuple(spec)))) for key, spec in base_rules]

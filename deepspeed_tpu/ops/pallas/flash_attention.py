@@ -39,7 +39,7 @@ def _blk(seq: int, want: int = DEFAULT_BLOCK) -> int:
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int, seq_k: int, scale: float,
                 causal: bool):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale  # (bq, D)
+    q = q_ref[0]  # (bq, D) input dtype — MXU runs bf16 operands w/ fp32 accumulation
     D = q.shape[-1]
 
     # queries align to the END of the kv sequence (matches attention_xla)
@@ -51,9 +51,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q:
 
     def body(j, carry):
         acc, m, l = carry
-        k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)  # (bk, D)
-        v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+        k = k_ref[0, pl.dslice(j * bk, bk), :]  # (bk, D)
+        v = v_ref[0, pl.dslice(j * bk, bk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # (bq, bk)
         if causal:
             rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -64,7 +65,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q:
         p = jnp.where(s <= NEG_INF, 0.0, p)
         corr = jnp.exp(m - new_m)
         new_l = l * corr + jnp.sum(p, axis=-1)
-        new_acc = acc * corr[:, None] + jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())))
+        new_acc = acc * corr[:, None] + jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                                                           preferred_element_type=jnp.float32)
         return new_acc, new_m, new_l
 
     acc0 = jnp.zeros((bq, D), jnp.float32)
@@ -108,8 +110,8 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
 # ----------------------------------------------------------------------
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, bk, seq_q, seq_k, scale, causal):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0, :, 0]
     delta = delta_ref[0, :, 0]
     D = q.shape[-1]
@@ -120,18 +122,18 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, b
         nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), nk)
 
     def body(j, dq):
-        k = k_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.dslice(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale
+        k = k_ref[0, pl.dslice(j * bk, bk), :]
+        v = v_ref[0, pl.dslice(j * bk, bk), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
         if causal:
             rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (bq, bk)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, D), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
@@ -140,8 +142,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, b
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, bq, bk, seq_q, seq_k, scale,
                 causal):
     kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]
+    v = v_ref[0]
     D = k.shape[-1]
 
     offset = seq_k - seq_q
@@ -153,21 +155,22 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, pl.dslice(i * bq, bq), :].astype(jnp.float32)
+        q = q_ref[0, pl.dslice(i * bq, bq), :]
+        do = do_ref[0, pl.dslice(i * bq, bq), :]
         lse = lse_ref[0, pl.dslice(i * bq, bq), 0]
         delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ()))) * scale  # (bq, bk)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
         if causal:
             rows = offset + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))  # (bk, D)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))
-        ds = p * (dp - delta[:, None]) * scale
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())))  # (bk, D)
+        pc = p.astype(do.dtype)
+        dv = dv + jax.lax.dot_general(pc, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         return dk, dv
 
     dk0 = jnp.zeros((bk, D), jnp.float32)

@@ -173,7 +173,8 @@ class DeepSpeedEngine:
         self.micro_steps = 0
         self.global_steps = 0
         self.global_samples = 0
-        self.skipped_steps = 0
+        self._skipped_host = 0
+        self._skipped_dev = None  # lazily-summed device overflow flags (static-scale path)
         self._grad_acc = None
         self._cached_grads = None
         self._last_loss = None
@@ -242,6 +243,7 @@ class DeepSpeedEngine:
         loss_fn = self._loss_fn
         compute_dtype = self.compute_dtype
         comp = self.compression_engine
+        base_rng = self._rng
 
         def scaled_loss_fn(params32, batch, rng, scale, comp_state):
             params_c = _cast_tree(params32, compute_dtype)
@@ -250,7 +252,9 @@ class DeepSpeedEngine:
             loss = loss_fn(params_c, batch, rng)
             return (loss * scale).astype(jnp.float32), loss
 
-        def fwd_bwd(params32, batch, rng, scale, comp_state):
+        def fwd_bwd(params32, batch, step, scale, comp_state):
+            # rng derivation lives inside the jit: one less per-step dispatch
+            rng = jax.random.fold_in(base_rng, step)
             (scaled, raw_loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(
                 params32, batch, rng, scale, comp_state)
             return raw_loss, grads
@@ -264,14 +268,15 @@ class DeepSpeedEngine:
         if zeropp_requested(self.config) and not use_zeropp:
             log_dist(f"ZeRO++ requested but falling back to GSPMD path: {zeropp_reason}", ranks=[0])
         if use_zeropp:
-            self._fwd_bwd = build_zeropp_fwd_bwd(loss_fn, self.param_specs, self.grad_specs,
-                                                 self.topology, self.config, compute_dtype)
+            zpp = build_zeropp_fwd_bwd(loss_fn, self.param_specs, self.grad_specs,
+                                       self.topology, self.config, compute_dtype)
+            self._fwd_bwd = lambda p, b, step, s: zpp(p, b, jax.random.fold_in(base_rng, step), s)
         elif comp is None:
-            self._fwd_bwd = jax.jit(lambda p, b, r, s: fwd_bwd(p, b, r, s, None),
+            self._fwd_bwd = jax.jit(lambda p, b, step, s: fwd_bwd(p, b, step, s, None),
                                     out_shardings=(None, self.grad_shardings))
         else:
             self._fwd_bwd_comp = jax.jit(fwd_bwd, out_shardings=(None, self.grad_shardings))
-            self._fwd_bwd = lambda p, b, r, s: self._fwd_bwd_comp(p, b, r, s, comp.comp_state())
+            self._fwd_bwd = lambda p, b, step, s: self._fwd_bwd_comp(p, b, step, s, comp.comp_state())
 
         def accumulate(acc, grads):
             return jax.tree_util.tree_map(lambda a, g: a + g.astype(a.dtype), acc, grads)
@@ -298,7 +303,10 @@ class DeepSpeedEngine:
                 lambda n, o: jnp.where(finite, n, o), new, old)
             return pick(new_params, params32), pick(new_opt_state, opt_state), gnorm, ~finite
 
-        self._apply_updates = jax.jit(apply_updates, donate_argnums=(0, 1, 2),
+        # donate params+opt_state only: their buffers alias the outputs
+        # one-to-one (donating grads too leaves an unusable donated buffer —
+        # XLA's "Some donated buffers were not usable" warning)
+        self._apply_updates = jax.jit(apply_updates, donate_argnums=(0, 1),
                                       out_shardings=(self.param_shardings, self.opt_state_shardings, None, None))
 
         def eval_loss(params32, batch, rng):
@@ -353,14 +361,13 @@ class DeepSpeedEngine:
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
         batch = self._put_batch(batch)
-        rng = jax.random.fold_in(self._rng, self.micro_steps)
         scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
         profiling = (self.config.flops_profiler.enabled
                      and self.global_steps == self.config.flops_profiler.profile_step
                      and self.micro_steps % self.gradient_accumulation_steps == 0)  # first micro-batch only
         if profiling:
-            self._start_flops_profile(batch, rng, scale)
-        loss, grads = self._fwd_bwd(self.params, batch, rng, scale)
+            self._start_flops_profile(batch, self.micro_steps, scale)
+        loss, grads = self._fwd_bwd(self.params, batch, self.micro_steps, scale)
         self._cached_grads = grads
         self._last_loss = loss
         if profiling:
@@ -407,13 +414,24 @@ class DeepSpeedEngine:
             self.params, self.opt_state, gnorm, overflow = self._apply_updates(
                 self.params, self.opt_state, self._grad_acc, inv_scale, lr)
         self._grad_acc = None
-        overflow_host = bool(overflow)
         self._global_grad_norm = gnorm
-        self.loss_scaler.update_scale(overflow_host)
-        if overflow_host:
-            self.skipped_steps += 1
-            log_dist(f"step {self.global_steps}: grad overflow — step skipped, "
-                     f"loss scale -> {self.loss_scaler.loss_scale}", ranks=[0])
+        if self.loss_scaler.dynamic or self._host_offload is not None:
+            # dynamic fp16 scaling needs the overflow bit on the host NOW
+            # (the scale feeds the next step) — this device->host sync is
+            # inherent to the algorithm, as in the reference
+            overflow_host = bool(overflow)
+            self.loss_scaler.update_scale(overflow_host)
+            if overflow_host:
+                self._skipped_host += 1
+                log_dist(f"step {self.global_steps}: grad overflow — step skipped, "
+                         f"loss scale -> {self.loss_scaler.loss_scale}", ranks=[0])
+        else:
+            # static scale (bf16/fp32): never block the dispatch pipeline on a
+            # per-step device->host readback (over a remote tunnel one scalar
+            # sync costs ~100ms). The skip-on-overflow happens in-graph;
+            # the counter folds lazily (see skipped_steps property).
+            self._skipped_dev = overflow.astype(jnp.int32) if self._skipped_dev is None \
+                else self._skipped_dev + overflow.astype(jnp.int32)
         self.global_steps += 1
         if self.random_ltd_scheduler is not None:
             self.random_ltd_scheduler.update_seq(self.global_steps)
@@ -427,15 +445,15 @@ class DeepSpeedEngine:
             if self._last_loss is not None:
                 self.monitor.write_events([("Train/Samples/train_loss", float(self._last_loss), self.global_samples)])
 
-    def _start_flops_profile(self, batch, rng, scale):
+    def _start_flops_profile(self, batch, step, scale):
         """Reference ``engine.py:1800,1817``: flops profiler on a configured step.
         The profiled unit here is the fused fwd+bwd jit (what actually runs)."""
         from ..profiling.flops_profiler import FlopsProfiler
 
         self.flops_profiler = FlopsProfiler(ds_engine=self,
                                             recompute_fwd_factor=self.config.flops_profiler.recompute_fwd_factor)
-        self.flops_profiler.analyze_fn(lambda p, b, r, s: self._fwd_bwd(p, b, r, s),
-                                       self.params, batch, rng, scale, params_tree=self.params)
+        self.flops_profiler.analyze_fn(lambda p, b, st, s: self._fwd_bwd(p, b, st, s),
+                                       self.params, batch, step, scale, params_tree=self.params)
         self.flops_profiler.start_profile()
 
     def _stop_flops_profile(self):
@@ -491,6 +509,18 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # introspection (reference engine accessors)
     # ------------------------------------------------------------------
+    @property
+    def skipped_steps(self) -> int:
+        """Overflow-skipped step count. Reading this syncs the lazily
+        accumulated device counter (one host roundtrip)."""
+        dev = 0 if self._skipped_dev is None else int(self._skipped_dev)
+        return self._skipped_host + dev
+
+    @skipped_steps.setter
+    def skipped_steps(self, value: int):
+        self._skipped_host = int(value)
+        self._skipped_dev = None
+
     def zero_optimization_stage(self) -> int:
         return self.config.zero_config.stage
 
