@@ -98,6 +98,10 @@ def main():
         if tps > best[0]:
             best = (tps, micro_bs, loss)
 
+    if best[1] is None:
+        print("[bench] every sweep config failed — refusing to report 0 throughput", file=sys.stderr)
+        return 1
+
     tokens_per_sec_chip = best[0] / n_dev
     baseline_tokens_per_sec_chip = 350_000.0  # see module docstring
     print(json.dumps({

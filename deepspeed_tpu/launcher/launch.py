@@ -50,7 +50,13 @@ def resolve_node_rank(world_info: Dict[str, List[int]], node_rank: int = -1) -> 
     # slurm/mpi give us a rank even when hostnames don't match the hostfile
     for var in ("SLURM_NODEID", "OMPI_COMM_WORLD_RANK", "PMI_RANK"):
         if var in os.environ:
-            return int(os.environ[var])
+            rank = int(os.environ[var])
+            if not 0 <= rank < len(world_info):
+                raise RuntimeError(
+                    f"{var}={rank} is outside the hostfile's world of {len(world_info)} node(s); "
+                    "the scheduler allocation is larger than the hostfile — pass --node_rank "
+                    "explicitly or fix the hostfile")
+            return rank
     raise RuntimeError(f"cannot determine node rank: hostname {hostname} not in {hosts} "
                        "and no scheduler rank env set")
 

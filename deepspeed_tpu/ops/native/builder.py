@@ -59,15 +59,18 @@ class NativeOpBuilder:
         # process must never dlopen a half-written .so
         tmp = out.with_name(f"{out.name}.tmp-{os.getpid()}")
         base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-o", str(tmp)] + [str(s) for s in self.sources]
-        # best flags first; fall back for conservative toolchains
-        for flags in (["-march=native", "-fopenmp"], ["-fopenmp"], []):
-            cmd = base + flags + self.extra_flags
-            r = subprocess.run(cmd, capture_output=True, text=True)
-            if r.returncode == 0:
-                os.replace(tmp, out)
-                logger.info(f"built native op {self.name}: {' '.join(cmd)}")
-                return out
-        raise RuntimeError(f"g++ failed for {self.name}: {r.stderr[-2000:]}")
+        try:
+            # best flags first; fall back for conservative toolchains
+            for flags in (["-march=native", "-fopenmp"], ["-fopenmp"], []):
+                cmd = base + flags + self.extra_flags
+                r = subprocess.run(cmd, capture_output=True, text=True)
+                if r.returncode == 0:
+                    os.replace(tmp, out)
+                    logger.info(f"built native op {self.name}: {' '.join(cmd)}")
+                    return out
+            raise RuntimeError(f"g++ failed for {self.name}: {r.stderr[-2000:]}")
+        finally:
+            tmp.unlink(missing_ok=True)
 
     def load(self) -> ctypes.CDLL:
         if self.name not in _loaded:

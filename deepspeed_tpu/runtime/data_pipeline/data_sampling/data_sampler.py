@@ -142,8 +142,7 @@ class DeepSpeedDataSampler:
             else:
                 pool = self._epoch_perm
             take = self.global_batch_size
-            if self.drop_last and not self.curriculum_enabled and \
-                    self.total_samples - self.consumed_samples < take:
+            if self.drop_last and self.total_samples - self.consumed_samples < take:
                 return
             while len(queue) < take:
                 queue = np.concatenate([queue, self.np_rng.permutation(pool).astype(self.index_dtype)])

@@ -47,6 +47,7 @@ MODEL_STATES_FILENAME = "model_states.msgpack"
 OPTIM_STATES_FILENAME = "optim_states.msgpack"
 CLIENT_STATE_FILENAME = "client_state.msgpack"
 CURRICULUM_STATE_FILENAME = "curriculum_state.msgpack"
+TRAIN_META_FILENAME = "train_meta.json"
 LATEST_FILENAME = "latest"
 
 
@@ -472,9 +473,15 @@ class DeepSpeedEngine:
 
     def _report(self, lr):
         loss = float(self._last_loss) if self._last_loss is not None else float("nan")
+        # the periodic report already pays a host sync — fold the lazy
+        # overflow counter here so static-scale overflow skips surface
+        # without a per-step readback
+        skipped = self.skipped_steps
+        skip_note = f" skipped={skipped}" if skipped else ""
         log_dist(
             f"step={self.global_steps} loss={loss:.4f} lr={lr:.3e} "
-            f"loss_scale={self.loss_scaler.loss_scale:.0f} gnorm={float(self._global_grad_norm):.3f}", ranks=[0])
+            f"loss_scale={self.loss_scaler.loss_scale:.0f} gnorm={float(self._global_grad_norm):.3f}{skip_note}",
+            ranks=[0])
         if self.wall_clock_breakdown:
             self.timers.log([FORWARD_GLOBAL_TIMER, BACKWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER],
                             memory_breakdown=self.config.memory_breakdown)
@@ -587,6 +594,12 @@ class DeepSpeedEngine:
             "skipped_steps": self.skipped_steps,
         }
         self.checkpoint_engine.save(optim_state, os.path.join(d, OPTIM_STATES_FILENAME))
+        if jax.process_index() == 0:
+            # plain-JSON step counters so module-only loads (which skip the
+            # optimizer states) can still restore step-indexed schedules
+            with open(os.path.join(d, TRAIN_META_FILENAME), "w") as f:
+                json.dump({"global_steps": self.global_steps, "micro_steps": self.micro_steps,
+                           "global_samples": self.global_samples}, f)
         if self.curriculum_scheduler is not None:
             # own file: plain-python state, no array template needed on load
             self.checkpoint_engine.save(self.curriculum_scheduler.get_state(),
@@ -640,15 +653,21 @@ class DeepSpeedEngine:
                 self.micro_steps = int(state["micro_steps"])
                 self.global_samples = int(state["global_samples"])
                 self.skipped_steps = int(state["skipped_steps"])
-                if self.compression_engine is not None:
-                    # scheduler state is just the step counter
-                    self.compression_engine.scheduler.training_steps = self.global_steps
             curriculum_path = os.path.join(d, CURRICULUM_STATE_FILENAME)
             if self.curriculum_scheduler is not None and os.path.exists(curriculum_path):
                 self.curriculum_scheduler.set_state(self.checkpoint_engine.load(curriculum_path))
             cs_path = os.path.join(d, CLIENT_STATE_FILENAME)
             if os.path.exists(cs_path):
                 client_state = self.checkpoint_engine.load(cs_path)
+        if self.compression_engine is not None:
+            # restore step-indexed compression schedules (QAT bit annealing,
+            # pruning offsets) even when the optimizer states were skipped
+            meta_path = os.path.join(d, TRAIN_META_FILENAME)
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    self.compression_engine.scheduler.training_steps = int(json.load(f)["global_steps"])
+            else:
+                self.compression_engine.scheduler.training_steps = self.global_steps
         return d, client_state
 
     def save_universal_checkpoint(self, save_dir: str, tag=None):

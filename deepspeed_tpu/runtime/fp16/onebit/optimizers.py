@@ -92,8 +92,14 @@ def _error_shapes(params, axis_name: Optional[str], world: int):
 
 def onebit_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                 weight_decay: float = 0.0, freeze_step: int = 100, axis_name: Optional[str] = None,
-                world: int = 1) -> optax.GradientTransformation:
-    """Reference ``OnebitAdam`` (``onebit/adam.py:14``)."""
+                world: int = 1, bias_correction: bool = False) -> optax.GradientTransformation:
+    """Reference ``OnebitAdam`` (``onebit/adam.py:14``).
+
+    ``bias_correction=False`` matches the reference: it computes a
+    bias_correction flag but the update is
+    ``exp_avg / (exp_avg_sq.sqrt() + eps)`` with no correction applied
+    (``onebit/adam.py:194,226``). Set True for textbook-Adam correction.
+    """
 
     def init(params):
         err, serr = _error_shapes(params, axis_name, world)
@@ -144,8 +150,11 @@ def onebit_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.error, new_err)
         kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.server_error, new_serr)
 
-        bc1 = 1 - b1**count.astype(jnp.float32)
-        bc2 = 1 - b2**jnp.minimum(count, freeze_step).astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1 - b1**count.astype(jnp.float32)
+            bc2 = 1 - b2**jnp.minimum(count, freeze_step).astype(jnp.float32)
+        else:  # reference behavior: no correction (onebit/adam.py:194)
+            bc1 = bc2 = jnp.ones((), jnp.float32)
 
         def step_leaf(m, v, p):
             upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
@@ -237,11 +246,15 @@ def zero_one_adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.99
 def onebit_lamb(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8,
                 weight_decay: float = 0.0, freeze_step: int = 100, max_coeff: float = 10.0,
                 min_coeff: float = 0.01, axis_name: Optional[str] = None,
-                world: int = 1) -> optax.GradientTransformation:
+                world: int = 1, bias_correction: bool = False) -> optax.GradientTransformation:
     """Reference ``OnebitLamb`` (``onebit/lamb.py``): LAMB during warmup
     (fresh trust ratios); after the freeze the momentum is compressed and
     the trust ratio reuses the scaling coefficient captured at the
-    boundary (reference keeps ``scaling_coeff`` per tensor)."""
+    boundary (reference keeps ``scaling_coeff`` per tensor).
+
+    ``bias_correction=False`` matches the reference update
+    ``exp_avg / (exp_avg_sq.sqrt() + eps)`` (``onebit/lamb.py:231,335``),
+    which applies no correction despite computing the flag."""
 
     def init(params):
         err, serr = _error_shapes(params, axis_name, world)
@@ -277,8 +290,11 @@ def onebit_lamb(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         kept_err = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.error, new_err)
         kept_serr = jax.tree_util.tree_map(lambda o, n: jnp.where(in_warmup, o, n), state.server_error, new_serr)
 
-        bc1 = 1 - b1**count.astype(jnp.float32)
-        bc2 = 1 - b2**jnp.minimum(count, freeze_step).astype(jnp.float32)
+        if bias_correction:
+            bc1 = 1 - b1**count.astype(jnp.float32)
+            bc2 = 1 - b2**jnp.minimum(count, freeze_step).astype(jnp.float32)
+        else:  # reference behavior: no correction (onebit/lamb.py:231,335)
+            bc1 = bc2 = jnp.ones((), jnp.float32)
 
         def lamb_leaf(m, v, p, coeff):
             upd = (m / bc1) / (jnp.sqrt(v / bc2) + eps)

@@ -54,7 +54,8 @@ def create_optimizer(name: Optional[str], params: Optional[Dict] = None) -> opta
         common = dict(b1=a["b1"], b2=a["b2"], eps=a["eps"], weight_decay=params.get("weight_decay", 0.0))
         if name == ONEBIT_ADAM:
             factory = lambda learning_rate, **kw: onebit_adam(
-                learning_rate, freeze_step=params.get("freeze_step", 100), **kw)
+                learning_rate, freeze_step=params.get("freeze_step", 100),
+                bias_correction=params.get("bias_correction", False), **kw)
         elif name == ZERO_ONE_ADAM:
             factory = lambda learning_rate, **kw: zero_one_adam(
                 learning_rate, var_freeze_step=params.get("var_freeze_step", 100),
@@ -62,7 +63,8 @@ def create_optimizer(name: Optional[str], params: Optional[Dict] = None) -> opta
         else:
             factory = lambda learning_rate, **kw: onebit_lamb(
                 learning_rate, freeze_step=params.get("freeze_step", 100),
-                max_coeff=params.get("max_coeff", 10.0), min_coeff=params.get("min_coeff", 0.01), **kw)
+                max_coeff=params.get("max_coeff", 10.0), min_coeff=params.get("min_coeff", 0.01),
+                bias_correction=params.get("bias_correction", False), **kw)
         return optax.inject_hyperparams(lambda learning_rate: factory(learning_rate, **common))(
             learning_rate=a["learning_rate"])
 

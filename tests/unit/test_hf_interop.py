@@ -1,0 +1,115 @@
+"""HF-checkpoint interop tests.
+
+Oracle style per SURVEY.md §4: load a real HF-format checkpoint written by
+``transformers`` and match its torch logits (the reference's checkpoint-
+loading contract, ``module_inject/load_checkpoint.py``), then serve it
+TP-sharded through ``init_inference`` on the virtual mesh.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(scope="module")
+def tiny_llama_ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_llama")
+    cfg = transformers.LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                                   num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=128,
+                                   rms_norm_eps=1e-6, tie_word_embeddings=False)
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    model.save_pretrained(d, safe_serialization=True)
+    ids = np.array([[1, 5, 9, 200, 42, 7, 13, 99]], dtype=np.int64)
+    with torch.no_grad():
+        ref_logits = model(torch.from_numpy(ids)).logits.numpy()
+    return str(d), ids.astype(np.int32), ref_logits
+
+
+@pytest.fixture(scope="module")
+def tiny_gpt2_ckpt(tmp_path_factory):
+    d = tmp_path_factory.mktemp("hf_gpt2")
+    cfg = transformers.GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4)
+    torch.manual_seed(1)
+    model = transformers.GPT2LMHeadModel(cfg).eval()
+    model.save_pretrained(d, safe_serialization=True)
+    ids = np.array([[3, 17, 250, 8, 0, 91, 44, 5]], dtype=np.int64)
+    with torch.no_grad():
+        ref_logits = model(torch.from_numpy(ids)).logits.numpy()
+    return str(d), ids.astype(np.int32), ref_logits
+
+
+def test_llama_logits_match(tiny_llama_ckpt):
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+
+    d, ids, ref_logits = tiny_llama_ckpt
+    model, params = load_hf_checkpoint(d)
+    logits = np.asarray(model.apply(params, ids))
+    np.testing.assert_allclose(logits, ref_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_gpt2_logits_match(tiny_gpt2_ckpt):
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+
+    d, ids, ref_logits = tiny_gpt2_ckpt
+    model, params = load_hf_checkpoint(d)
+    logits = np.asarray(model.apply(params, ids))
+    np.testing.assert_allclose(logits, ref_logits, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_sharded_load_tp2(tiny_llama_ckpt):
+    """Born-sharded load + generate over a tensor=2 mesh — the AutoTP
+    promise (ref ``inference/engine.py:331`` + ``auto_tp.py``)."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    d, ids, ref_logits = tiny_llama_ckpt
+    topo = initialize_mesh(MeshConfig.from_dict({"data": 4, "tensor": 2}), force=True)
+    model, params = load_hf_checkpoint(d, mesh=topo, shard=True)
+    # TP rules actually applied: q_proj kernel sharded over heads
+    qk = params["layer_0"]["attn"]["q_proj"]["kernel"]
+    assert len(qk.sharding.device_set) == 8  # mesh-wide sharding object
+    engine = deepspeed_tpu.init_inference(model, config={"tensor_parallel": {"tp_size": 2}, "dtype": "fp32"},
+                                          params=params, mesh=topo)
+    logits = np.asarray(engine.forward(ids))
+    np.testing.assert_allclose(logits, ref_logits, rtol=5e-4, atol=5e-4)
+    out = engine.generate(ids, max_new_tokens=4)
+    assert out.shape == (1, ids.shape[1] + 4)
+    # greedy continuation must match the torch oracle's argmax chain
+    with torch.no_grad():
+        tm = transformers.LlamaForCausalLM.from_pretrained(d).eval()
+        tids = torch.from_numpy(np.asarray(ids, np.int64))
+        tout = tm.generate(tids, max_new_tokens=4, do_sample=False)
+    np.testing.assert_array_equal(np.asarray(out), tout.numpy())
+
+
+def test_sharded_index_roundtrip(tiny_llama_ckpt, tmp_path):
+    """Sharded (index.json) checkpoints load identically to single-file."""
+    import safetensors.torch
+
+    from deepspeed_tpu.module_inject import load_hf_state_dict
+
+    d, _, _ = tiny_llama_ckpt
+    full = load_hf_state_dict(d)
+    # re-write as two shards + index
+    keys = sorted(full.keys())
+    half = len(keys) // 2
+    shards = {"model-00001-of-00002.safetensors": keys[:half], "model-00002-of-00002.safetensors": keys[half:]}
+    weight_map = {}
+    for fname, ks in shards.items():
+        safetensors.torch.save_file({k: torch.from_numpy(full[k]) for k in ks}, str(tmp_path / fname))
+        weight_map.update({k: fname for k in ks})
+    (tmp_path / "model.safetensors.index.json").write_text(json.dumps({"weight_map": weight_map}))
+    again = load_hf_state_dict(str(tmp_path))
+    assert set(again) == set(full)
+    for k in full:
+        np.testing.assert_array_equal(full[k], again[k])
