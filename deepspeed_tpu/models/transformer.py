@@ -324,13 +324,16 @@ class CausalLM:
 
         ``params``: existing parameter pytree to restructure (preferred);
         otherwise freshly initialized from ``rng`` + ``example_batch``.
-        Returns (pipe_params, embed_fn, stage_fn, head_loss_fn, rules).
+        Returns (pipe_params, embed_fn, stage_fn, head_loss_fn, rules);
+        ``embed_fn``/``head_loss_fn`` receive the shared non-stage param
+        groups ``{"embed", "head"}`` so tied embeddings (reference
+        ``TiedLayerSpec``, ``pipe/module.py:77``) are ONE leaf used by
+        both ends — the compiler sums its two grad contributions, which is
+        the reference's tied-grad allreduce (``pipe/engine.py:264``).
         """
         cfg = self.cfg
         if cfg.n_layers % num_stages != 0:
             raise ValueError(f"n_layers={cfg.n_layers} must divide evenly into {num_stages} pipeline stages")
-        if cfg.tie_embeddings:
-            raise ValueError("pipeline requires tie_embeddings=False (embed and head live on different stages)")
         if cfg.moe_num_experts > 0:
             raise NotImplementedError("MoE + pipeline composition lands with expert-parallel pipeline support")
         if cfg.scan_layers:
@@ -354,7 +357,8 @@ class CausalLM:
         block = Block(cfg, layer_idx=0)
         norm_key = [k for k in head_params if "Norm" in k]
 
-        def embed_fn(ep, input_ids):
+        def embed_fn(ps, input_ids):
+            ep = ps["embed"]
             B, S = input_ids.shape
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
             x = ep["wte"][input_ids].astype(cfg.dtype)
@@ -369,9 +373,10 @@ class CausalLM:
                 x = block.apply({"params": sp[f"sub_{j}"]}, x, positions)
             return x
 
-        def head_loss_fn(hp, x, labels_or_ids, labels_are_shifted: bool):
+        def head_loss_fn(ps, x, labels_or_ids, labels_are_shifted: bool):
             from ..ops.fused_ce import fused_cross_entropy
 
+            hp = ps["head"]
             norm = make_norm(cfg)
             x = norm.apply({"params": hp[norm_key[0]]}, x) if norm_key else x
             if labels_are_shifted:
@@ -379,6 +384,8 @@ class CausalLM:
             else:
                 ids = labels_or_ids
                 labels = jnp.concatenate([ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
+            if cfg.tie_embeddings:
+                return fused_cross_entropy(x, ps["embed"]["wte"].astype(cfg.dtype), labels, vd_layout=True)
             return fused_cross_entropy(x, hp["lm_head"]["kernel"].astype(cfg.dtype), labels, vd_layout=False)
 
         base_rules = self.partition_rules()

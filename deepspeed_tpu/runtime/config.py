@@ -198,6 +198,10 @@ class PipelineConfig(DeepSpeedConfigModel):
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
     use_reentrant: bool = True
+    # "1f1b": O(stages) activation memory, manual interleaved fwd/bwd clocks
+    # (reference TrainSchedule semantics, schedule.py:189); "gpipe": all-
+    # forward scan then autodiff (O(microbatches) activation memory)
+    schedule: str = "1f1b"
 
 
 @dataclass

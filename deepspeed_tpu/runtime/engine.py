@@ -506,7 +506,9 @@ class DeepSpeedEngine:
 
     def eval_batch(self, batch, rng=None):
         batch = self._put_batch(batch)
-        rng = rng if rng is not None else jax.random.fold_in(self._rng, -1 - self.micro_steps)
+        # disjoint from the train-step folds, which use micro_steps directly
+        # (fold_in data must be non-negative: it coerces to uint32)
+        rng = rng if rng is not None else jax.random.fold_in(self._rng, (1 << 30) + self.micro_steps)
         return self._eval_loss(self.params, batch, rng)
 
     def zero_grad(self):

@@ -5,18 +5,30 @@ Parity target: reference ``runtime/pipe/engine.py`` (``PipelineEngine``,
 p2p). The TPU-native execution model is different and better suited to
 XLA: instead of S processes interpreting per-stage instruction streams,
 ONE compiled program holds stage-stacked parameters (leading dim sharded
-over the ``pipe`` mesh axis) and runs M + S - 1 pipeline clocks inside
-``lax.scan``:
+over the ``pipe`` mesh axis) and runs pipeline clocks inside ``lax.scan``.
+Two schedules:
 
-- every clock, all stages apply their block stack in parallel (a ``vmap``
-  over the sharded stage dim — zero communication);
-- the activation buffer is rolled by one along the stage dim, which XLA
-  lowers to a CollectivePermute over ICI — the compiled analogue of the
-  reference's ``SendActivation``/``RecvActivation`` pair;
-- ``jax.grad`` through the scan generates the reverse clock loop with the
-  opposite permute — ``SendGrad``/``RecvGrad`` for free;
-- the declarative schedules in ``schedule.py`` document/validate the same
-  instruction stream the compiled loop realizes.
+- ``1f1b`` (default): the reference ``TrainSchedule`` (``schedule.py:189``)
+  realized as a *manually interleaved* forward/backward clock loop under
+  ``jax.custom_vjp``. Each macro-clock every stage runs one forward (vmap
+  over the sharded stage dim) and one backward (``jax.vjp`` against the
+  stashed stage input — recompute-style, the reference's activation
+  checkpointing default). Activation state is a ring stash of depth
+  ``min(2S-1, M)`` — **independent of the microbatch count M**, the
+  1F1B memory bound the reference gets from interleaving (its GPipe-mode
+  would be O(M)). Transfers are one-slot rolls of the stage-stacked
+  buffers, which XLA lowers to CollectivePermute over ICI — the compiled
+  analogue of Send/RecvActivation and Send/RecvGrad.
+- ``gpipe``: all-forward scan then autodiff through it (O(M) activation
+  memory, slightly fewer bubble clocks) — the reference's inference-style
+  schedule generalized to training.
+
+Tied weights (reference ``TiedLayerSpec`` + tied-grad allreduce,
+``pipe/engine.py:264``): embed/head functions receive the shared
+``{"embed", "head"}`` param groups, so a tied embedding is ONE leaf used
+twice; both schedules accumulate its two cotangent contributions, which
+is exactly the reference's cross-stage tied-grad reduction done by the
+compiler instead of by hand.
 
 Hybrid parallelism: data/ZeRO-1 sharding composes via the engine's normal
 partition planner (the reference likewise restricts pipeline to ZeRO≤1,
@@ -46,6 +58,19 @@ class _PipeModelWrapper:
         return self._rules
 
 
+def _mask_tree(valid, tree):
+    """Zero a cotangent tree when ``valid`` (scalar bool) is False."""
+    return jax.tree_util.tree_map(lambda g: jnp.where(valid, g, jnp.zeros_like(g)), tree)
+
+
+def _add_tree(acc, tree):
+    return jax.tree_util.tree_map(lambda a, g: a + g.astype(a.dtype), acc, tree)
+
+
+def _zeros_f32(tree):
+    return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), tree)
+
+
 class PipelineEngine(DeepSpeedEngine):
     def __init__(self, args=None, model=None, optimizer=None, model_parameters=None, training_data=None,
                  lr_scheduler=None, mesh=None, mpu=None, dist_init_required=None, collate_fn=None, config=None,
@@ -66,17 +91,13 @@ class PipelineEngine(DeepSpeedEngine):
         self.num_microbatches = cfg.gradient_accumulation_steps
 
         # --- build the pipelined model parts ---
-        if isinstance(model, PipelineModule):
-            raise NotImplementedError(
-                "LayerSpec-list PipelineModule execution lands via model.to_pipeline; wrap your model with a "
-                "to_pipeline(num_stages, rng, batch) protocol (models.CausalLM implements it)")
-        if not hasattr(model, "to_pipeline"):
-            raise TypeError("pipeline model must implement to_pipeline(num_stages, rng, example_batch)")
-
         example_batch = kwargs.pop("example_batch", None)
         if example_batch is None:
             seq = getattr(getattr(model, "cfg", None), "max_seq_len", 128)
             example_batch = {"input_ids": np.zeros((1, min(seq, 128)), dtype=np.int32)}
+        if not hasattr(model, "to_pipeline"):
+            raise TypeError("pipeline model must implement to_pipeline(num_stages, params, rng, example_batch) "
+                            "(models.CausalLM and pipe.PipelineModule both do)")
         pipe_params, embed_fn, stage_fn, head_loss_fn, rules = model.to_pipeline(
             num_stages, params=model_parameters, rng=jax.random.PRNGKey(kwargs.pop("seed", 0)),
             example_batch=example_batch)
@@ -87,8 +108,15 @@ class PipelineEngine(DeepSpeedEngine):
 
         remat = cfg.activation_checkpointing.partition_activations or cfg.pipeline.activation_checkpoint_interval > 0 \
             or getattr(getattr(model, "cfg", None), "remat", False)
-        loss_fn = self._build_pipeline_loss(topo, num_stages, self.num_microbatches, embed_fn, stage_fn,
-                                            head_loss_fn, remat)
+        schedule = cfg.pipeline.schedule.lower()
+        if schedule == "1f1b":
+            loss_fn = self._build_1f1b_loss(topo, num_stages, self.num_microbatches, embed_fn, stage_fn,
+                                            head_loss_fn)
+        elif schedule == "gpipe":
+            loss_fn = self._build_gpipe_loss(topo, num_stages, self.num_microbatches, embed_fn, stage_fn,
+                                             head_loss_fn, remat)
+        else:
+            raise ValueError(f"pipeline.schedule must be '1f1b' or 'gpipe', got {schedule!r}")
         wrapper = _PipeModelWrapper(loss_fn, rules)
 
         super().__init__(args=args, model=wrapper, optimizer=optimizer, model_parameters=pipe_params,
@@ -97,10 +125,190 @@ class PipelineEngine(DeepSpeedEngine):
         # the pipelined loss averages its M microbatches internally: one
         # engine-level micro step per train_batch
         self.gradient_accumulation_steps = 1
-        log_dist(f"PipelineEngine: stages={num_stages} microbatches={self.num_microbatches}", ranks=[0])
+        log_dist(f"PipelineEngine: stages={num_stages} microbatches={self.num_microbatches} schedule={schedule}",
+                 ranks=[0])
 
     # ------------------------------------------------------------------
-    def _build_pipeline_loss(self, topo, S, M, embed_fn, stage_fn, head_loss_fn, remat: bool):
+    # 1F1B: interleaved clocks under custom_vjp — O(S) activation memory
+    # ------------------------------------------------------------------
+    def _build_1f1b_loss(self, topo, S, M, embed_fn, stage_fn, head_loss_fn):
+        """Clocked 1F1B (reference ``TrainSchedule``, ``schedule.py:189``).
+
+        Macro-clock k (k in [0, M + 2S - 2)):
+          - stage s FORWARDS microbatch ``k - s`` (valid in [0, M));
+          - stage s BACKWARDS microbatch ``k - (2S - 2) + s``;
+          - the last stage backwards the microbatch it forwarded the same
+            clock (loss grad feeds straight in);
+          - activations/grads travel one stage per clock via rolls.
+        In-flight stash per stage ≤ min(2S-1, M) microbatches — the 1F1B
+        activation bound, vs GPipe's M.
+        """
+        batch_axes = topo.batch_axes
+        baxis = batch_axes if len(batch_axes) > 1 else batch_axes[0]
+        mesh = topo.mesh
+        pspec = NamedSharding(mesh, P("pipe", baxis))
+        D = max(1, min(2 * S - 1, M))  # stash ring depth (+1 garbage slot below)
+        T = M + 2 * S - 2
+        s_idx = jnp.arange(S)
+
+        def split_io(params):
+            return {k: v for k, v in params.items() if k != "stages"}
+
+        def run_fwd_bwd(params, batch):
+            """One full 1F1B pass; returns (mean_loss, grads-tree)."""
+            ids = batch["input_ids"]
+            assert ids.ndim == 3, "pipeline batch must be stacked (microbatches, batch, seq)"
+            labels = batch.get("labels")
+            ps_io = split_io(params)
+
+            x0_shape = jax.eval_shape(embed_fn, ps_io, jax.eval_shape(lambda i: i[0], ids))
+            act_shape, act_dtype = (S,) + x0_shape.shape, x0_shape.dtype
+
+            fwd_buf = jnp.zeros(act_shape, act_dtype)
+            bwd_buf = jnp.zeros(act_shape, act_dtype)
+            stash = jnp.zeros((S, D + 1) + x0_shape.shape, act_dtype)  # slot D = invalid writes
+            acc_stage = _zeros_f32(params["stages"])
+            acc_io = _zeros_f32(ps_io)
+            loss_acc = jnp.zeros((), jnp.float32)
+
+            def stage_vjp(p_s, x, g):
+                _, pull = jax.vjp(stage_fn, p_s, x)
+                gp, gx = pull(g)
+                return gx, gp
+
+            def clock(carry, k):
+                fwd_buf, bwd_buf, stash, acc_stage, acc_io, loss_acc = carry
+                fwd_buf = jax.lax.with_sharding_constraint(fwd_buf, pspec)
+                bwd_buf = jax.lax.with_sharding_constraint(bwd_buf, pspec)
+
+                # ---- forward ladder (LoadMicroBatch/Recv+ForwardPass) ----
+                mf = k - s_idx  # per-stage forward microbatch
+                fwd_valid = (mf >= 0) & (mf < M)
+                x_embed = embed_fn(ps_io, jax.lax.dynamic_index_in_dim(
+                    ids, jnp.clip(k, 0, M - 1), axis=0, keepdims=False))
+                x_in = jax.lax.dynamic_update_index_in_dim(fwd_buf, x_embed.astype(fwd_buf.dtype), 0, axis=0)
+                # stash stage inputs for the recompute-backward; invalid
+                # clocks write to the spare slot D
+                slots = jnp.where(fwd_valid, jnp.mod(mf, D), D)
+                stash = jax.vmap(lambda st, slot, xi: jax.lax.dynamic_update_index_in_dim(st, xi, slot, axis=0))(
+                    stash, slots, x_in)
+                y = jax.vmap(stage_fn)(params["stages"], x_in)
+                y = jax.lax.with_sharding_constraint(y, pspec)
+
+                # ---- head: loss + seed grad (last stage's 1F1B pair) ----
+                mb_last = k - (S - 1)
+                head_valid = (mb_last >= 0) & (mb_last < M)
+                mb_last_c = jnp.clip(mb_last, 0, M - 1)
+                y_last = y[S - 1]
+                if labels is not None:
+                    lab = jax.lax.dynamic_index_in_dim(labels, mb_last_c, axis=0, keepdims=False)
+                    shifted = True
+                else:
+                    lab = jax.lax.dynamic_index_in_dim(ids, mb_last_c, axis=0, keepdims=False)
+                    shifted = False
+                loss_k, pull_head = jax.vjp(lambda pp, yy: head_loss_fn(pp, yy, lab, shifted), ps_io, y_last)
+                g_io_head, gy = pull_head(jnp.ones((), loss_k.dtype))
+                loss_acc = loss_acc + jnp.where(head_valid, loss_k.astype(jnp.float32), 0.0)
+                acc_io = _add_tree(acc_io, _mask_tree(head_valid, g_io_head))
+
+                # ---- backward ladder (Recv+BackwardPass+SendGrad) ----
+                mb = k - (2 * S - 2) + s_idx
+                bwd_valid = (mb >= 0) & (mb < M)
+                g_in = jax.lax.dynamic_update_index_in_dim(bwd_buf, gy.astype(bwd_buf.dtype), S - 1, axis=0)
+                read_slots = jnp.where(bwd_valid, jnp.mod(mb, D), D)
+                x_saved = jax.vmap(lambda st, slot: jax.lax.dynamic_index_in_dim(st, slot, axis=0,
+                                                                                 keepdims=False))(stash, read_slots)
+                gx, gp = jax.vmap(stage_vjp)(params["stages"], x_saved, g_in)
+                gx = jax.lax.with_sharding_constraint(gx, pspec)
+
+                def acc_leaf(a, g):
+                    m = bwd_valid.reshape((S,) + (1,) * (g.ndim - 1))
+                    return a + jnp.where(m, g, 0).astype(a.dtype)
+
+                acc_stage = jax.tree_util.tree_map(acc_leaf, acc_stage, gp)
+
+                # ---- embedding backward (stage 0's SendGrad terminus) ----
+                mb0 = k - (2 * S - 2)
+                emb_valid = (mb0 >= 0) & (mb0 < M)
+                ids0 = jax.lax.dynamic_index_in_dim(ids, jnp.clip(mb0, 0, M - 1), axis=0, keepdims=False)
+                _, pull_emb = jax.vjp(lambda pp: embed_fn(pp, ids0), ps_io)
+                (g_io_emb,) = pull_emb(gx[0].astype(act_dtype))
+                acc_io = _add_tree(acc_io, _mask_tree(emb_valid, g_io_emb))
+
+                # ---- transfers: CollectivePermute over the pipe axis ----
+                fwd_buf = jnp.roll(y, 1, axis=0)
+                bwd_buf = jnp.roll(gx, -1, axis=0)
+                return (fwd_buf, bwd_buf, stash, acc_stage, acc_io, loss_acc), None
+
+            carry = (fwd_buf, bwd_buf, stash, acc_stage, acc_io, loss_acc)
+            (_, _, _, acc_stage, acc_io, loss_acc), _ = jax.lax.scan(clock, carry, jnp.arange(T))
+
+            inv_m = 1.0 / M
+            grads = dict(acc_io)
+            grads["stages"] = acc_stage
+            # grads stay fp32 here: the loss scale multiplies them in the
+            # custom-vjp bwd BEFORE the cast to param dtype, so fp16 dynamic
+            # loss scaling can lift subnormal gradients (the reference's
+            # scaled-backward contract)
+            grads = jax.tree_util.tree_map(lambda g: g * inv_m, grads)
+            return loss_acc * inv_m, grads
+
+        def run_fwd_only(params, batch):
+            """Forward-only clocks for eval (reference InferenceSchedule)."""
+            ids = batch["input_ids"]
+            assert ids.ndim == 3, "pipeline batch must be stacked (microbatches, batch, seq)"
+            labels = batch.get("labels")
+            ps_io = split_io(params)
+            x0_shape = jax.eval_shape(embed_fn, ps_io, jax.eval_shape(lambda i: i[0], ids))
+            buf = jnp.zeros((S,) + x0_shape.shape, x0_shape.dtype)
+            loss_acc = jnp.zeros((), jnp.float32)
+
+            def clock(carry, k):
+                buf, loss_acc = carry
+                buf = jax.lax.with_sharding_constraint(buf, pspec)
+                x_embed = embed_fn(ps_io, jax.lax.dynamic_index_in_dim(
+                    ids, jnp.clip(k, 0, M - 1), axis=0, keepdims=False))
+                x_in = jax.lax.dynamic_update_index_in_dim(buf, x_embed.astype(buf.dtype), 0, axis=0)
+                y = jax.vmap(stage_fn)(params["stages"], x_in)
+                y = jax.lax.with_sharding_constraint(y, pspec)
+                mb_last = k - (S - 1)
+                head_valid = (mb_last >= 0) & (mb_last < M)
+                mb_last_c = jnp.clip(mb_last, 0, M - 1)
+                if labels is not None:
+                    loss_k = head_loss_fn(ps_io, y[S - 1],
+                                          jax.lax.dynamic_index_in_dim(labels, mb_last_c, 0, keepdims=False), True)
+                else:
+                    loss_k = head_loss_fn(ps_io, y[S - 1],
+                                          jax.lax.dynamic_index_in_dim(ids, mb_last_c, 0, keepdims=False), False)
+                loss_acc = loss_acc + jnp.where(head_valid, loss_k.astype(jnp.float32), 0.0)
+                return (jnp.roll(y, 1, axis=0), loss_acc), None
+
+            (_, loss_acc), _ = jax.lax.scan(clock, (buf, loss_acc), jnp.arange(M + S - 1))
+            return loss_acc / M
+
+        @jax.custom_vjp
+        def pipeline_loss(params, batch):
+            return run_fwd_only(params, batch)
+
+        def pipeline_loss_fwd(params, batch):
+            loss, grads = run_fwd_bwd(params, batch)
+            return loss, (grads, params)
+
+        def pipeline_loss_bwd(res, g):
+            grads_f32, params = res
+            return (jax.tree_util.tree_map(lambda x, p: (x * g).astype(p.dtype), grads_f32, params), None)
+
+        pipeline_loss.defvjp(pipeline_loss_fwd, pipeline_loss_bwd)
+
+        def loss_fn(params, batch, rng=None):
+            return pipeline_loss(params, batch)
+
+        return loss_fn
+
+    # ------------------------------------------------------------------
+    # GPipe: all-forward scan, autodiff backward — O(M) activation memory
+    # ------------------------------------------------------------------
+    def _build_gpipe_loss(self, topo, S, M, embed_fn, stage_fn, head_loss_fn, remat: bool):
         batch_axes = topo.batch_axes
         baxis = batch_axes if len(batch_axes) > 1 else batch_axes[0]
         mesh = topo.mesh
@@ -110,8 +318,9 @@ class PipelineEngine(DeepSpeedEngine):
             ids = batch["input_ids"]  # (M, G, seq)
             assert ids.ndim == 3, "pipeline batch must be stacked (microbatches, batch, seq)"
             labels = batch.get("labels")
+            ps_io = {k: v for k, v in params.items() if k != "stages"}
 
-            x_all = jax.vmap(lambda mb: embed_fn(params["embed"], mb))(ids)  # (M, G, seq, d)
+            x_all = jax.vmap(lambda mb: embed_fn(ps_io, mb))(ids)  # (M, G, seq, d)
             x_all = jax.lax.with_sharding_constraint(x_all, NamedSharding(mesh, P(None, baxis)))
             G, seq, d = x_all.shape[1], x_all.shape[2], x_all.shape[3]
 
@@ -139,9 +348,9 @@ class PipelineEngine(DeepSpeedEngine):
             (buf, outputs), _ = jax.lax.scan(clock, (buf, outputs), jnp.arange(M + S - 1))
 
             if labels is not None:
-                losses = jax.vmap(lambda o, l: head_loss_fn(params["head"], o, l, True))(outputs, labels)
+                losses = jax.vmap(lambda o, l: head_loss_fn(ps_io, o, l, True))(outputs, labels)
             else:
-                losses = jax.vmap(lambda o, i: head_loss_fn(params["head"], o, i, False))(outputs, ids)
+                losses = jax.vmap(lambda o, i: head_loss_fn(ps_io, o, i, False))(outputs, ids)
             return jnp.mean(losses)
 
         return loss_fn

@@ -168,3 +168,178 @@ class PipelineModule:
 
     def num_layers(self) -> int:
         return len(self.layer_specs)
+
+    # ------------------------------------------------------------------
+    # compiled execution (the engine's to_pipeline protocol)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _spec_sig(spec):
+        if isinstance(spec, TiedLayerSpec):
+            return ("tied", spec.key)
+        if isinstance(spec, LayerSpec):
+            try:
+                kw = tuple(sorted(spec.module_kwargs.items()))
+            except TypeError:
+                kw = id(spec)
+            return (spec.typename, spec.module_args, kw)
+        return ("obj", id(spec))
+
+    def _find_body(self, num_stages: int):
+        """Longest run of identically-specified consecutive layers — the
+        stacked pipeline body. Everything before is the (replicated)
+        prologue, everything after the epilogue."""
+        sigs = [self._spec_sig(s) for s in self.layer_specs]
+        best = (0, 0)  # (start, length)
+        i = 0
+        while i < len(sigs):
+            j = i
+            while j < len(sigs) and sigs[j] == sigs[i] and sigs[j][0] != "tied":
+                j += 1
+            if j - i > best[1]:
+                best = (i, j - i)
+            i = max(j, i + 1)
+        start, length = best
+        if length < num_stages or length % num_stages != 0:
+            raise ValueError(
+                f"PipelineModule needs a homogeneous run of layers divisible by num_stages={num_stages} to "
+                f"stack over the pipe axis; found a run of {length} identical specs at index {start} over "
+                f"{len(sigs)} layers. Pad the repeated block or change num_stages.")
+        return start, length
+
+    @staticmethod
+    def _is_flax(layer) -> bool:
+        return hasattr(layer, "init") and hasattr(layer, "apply")
+
+    def to_pipeline(self, num_stages: Optional[int] = None, params=None, rng=None, example_batch=None):
+        """Compile the LayerSpec list into the engine's stacked-stage form
+        (reference builds per-stage ``nn.Sequential``s, ``module.py:370``).
+
+        Returns ``(pipe_params, embed_fn, stage_fn, head_loss_fn, rules)``.
+        ``TiedLayerSpec`` params live ONCE under ``embed["tied_<key>"]``
+        and are read by every occurrence; the compiler sums their grad
+        contributions (the reference's tied-grad allreduce,
+        ``pipe/engine.py:264``).
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        S = num_stages or self.num_stages
+        if not S:
+            raise ValueError("num_stages required (constructor or to_pipeline arg)")
+        if self.loss_fn is None:
+            raise ValueError("PipelineModule needs loss_fn=(outputs, labels) -> scalar for training")
+        rng = rng if rng is not None else jax.random.PRNGKey(self.base_seed)
+        x = example_batch["input_ids"] if isinstance(example_batch, dict) else example_batch
+        if x is None:
+            raise ValueError("example_batch required to trace layer shapes")
+        x = jnp.asarray(x)
+
+        start, length = self._find_body(S)
+        lps = length // S
+        layers = [spec.build() if isinstance(spec, LayerSpec) else spec for spec in self.layer_specs]
+        if not self._is_flax(layers[start]):
+            raise ValueError(
+                f"the pipeline body (layers {start}..{start + length - 1}) must be flax modules — their params "
+                "are stacked over the pipe axis; plain callables can only appear in the prologue/epilogue")
+
+        # stream the example through every layer, initializing params
+        per_layer: List = []
+        tied: Dict[str, Any] = {}
+        for i, (spec, layer) in enumerate(zip(self.layer_specs, layers)):
+            rng, sub = jax.random.split(rng)
+            if not self._is_flax(layer):
+                per_layer.append(None)
+                x = layer(x)
+                continue
+            key = spec.key if isinstance(spec, TiedLayerSpec) else None
+            if key is not None and key in tied:
+                per_layer.append(("tied", key))
+            else:
+                p = layer.init(sub, x)["params"]
+                if key is not None:
+                    tied[key] = p
+                    per_layer.append(("tied", key))
+                else:
+                    per_layer.append(("own", i, p))
+            p_use = tied[key] if key is not None else per_layer[-1][2]
+            fwd = getattr(spec, "forward_fn", None)
+            x = fwd(layer, p_use, x) if fwd is not None else layer.apply({"params": p_use}, x)
+
+        def own_params(idx_range):
+            return {f"layer_{i}": per_layer[i][2] for i in idx_range
+                    if per_layer[i] is not None and per_layer[i][0] == "own"}
+
+        prologue = list(range(start))
+        epilogue = list(range(start + length, len(layers)))
+        if params is not None:
+            # resume path: adopt an existing pipe-param tree (the engine's
+            # checkpoint layout) instead of the fresh init
+            missing = {"embed", "stages", "head"} - set(params)
+            if missing:
+                raise ValueError(f"params must be a pipe-param tree with embed/stages/head groups; missing {missing}")
+            pipe_params = params
+        else:
+            embed_params = own_params(prologue)
+            embed_params.update({f"tied_{k}": v for k, v in tied.items()})
+            head_params = own_params(epilogue)
+            stages = {}
+            for j in range(lps):
+                per_stage = [per_layer[start + s * lps + j][2] for s in range(S)]
+                stages[f"sub_{j}"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *per_stage)
+            pipe_params = {"embed": embed_params, "stages": stages, "head": head_params}
+        # drop every init-time param copy: the engine holds embed_fn/apply_one
+        # closures over per_layer for the rest of its life, and pipe_params is
+        # now the only live copy of the weights (apply_one reads params from
+        # the ps tree, never from these tags)
+        for i, tag in enumerate(per_layer):
+            if tag is not None and tag[0] == "own":
+                per_layer[i] = ("own", tag[1])
+        tied_keys = list(tied)
+        tied.clear()
+
+        body_layer = layers[start]
+        specs_list = self.layer_specs
+
+        def apply_one(ps, i, x):
+            layer = layers[i]
+            tag = per_layer[i]
+            if tag is None:
+                return layer(x)
+            if tag[0] == "tied":
+                p = ps["embed"][f"tied_{tag[1]}"]
+                fwd = getattr(specs_list[i], "forward_fn", None)
+                if fwd is not None:
+                    return fwd(layer, p, x)
+                return layer.apply({"params": p}, x)
+            group = "embed" if i < start else "head"
+            return layer.apply({"params": ps[group][f"layer_{i}"]}, x)
+
+        def embed_fn(ps, x):
+            for i in prologue:
+                x = apply_one(ps, i, x)
+            return x
+
+        def stage_fn(sp, x):
+            for j in range(lps):
+                x = body_layer.apply({"params": sp[f"sub_{j}"]}, x)
+            return x
+
+        loss_fn = self.loss_fn
+
+        def head_loss_fn(ps, x, labels_or_ids, labels_are_shifted: bool):
+            if not labels_are_shifted:
+                # generic loss_fn(outputs, labels) has reference semantics:
+                # labels come from the dataloader, never derived from inputs
+                # (the engine passes shifted=False only when the batch had
+                # no 'labels' key)
+                raise ValueError("PipelineModule batches must carry 'labels' — its loss_fn(outputs, labels) "
+                                 "does no implicit next-token shift (add labels to each batch dict)")
+            for i in epilogue:
+                x = apply_one(ps, i, x)
+            return loss_fn(x, labels_or_ids)
+
+        rules = [(("stages",), P("pipe"))]
+        logger.info(f"PipelineModule.to_pipeline: prologue={len(prologue)} body={length}x@{start} "
+                    f"epilogue={len(epilogue)} stages={S} tied={tied_keys}")
+        return pipe_params, embed_fn, stage_fn, head_loss_fn, rules
