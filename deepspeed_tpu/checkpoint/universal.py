@@ -75,7 +75,25 @@ def _moment_file(i: int) -> str:
 def _write_universal(out_dir: str, tag: str, params_flat: Dict[str, np.ndarray],
                      moments: List[Dict[str, np.ndarray]], scalar_state: Dict[str, Any],
                      counters: Dict[str, Any]) -> str:
+    import jax
+
     root = os.path.join(out_dir, tag)
+    multi = jax.process_count() > 1
+    if multi and jax.process_index() != 0:
+        # every host holds the full tree after _to_host; rank 0 writes —
+        # but nobody returns until the write is durable (barrier below)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"universal_save:{tag}")
+        return root
+    # stage into a tmp dir and rename: a reader (or a preempted writer)
+    # never sees a half-written checkpoint under the final name
+    final_root = root
+    root = f"{root}.tmp-writing"
+    if os.path.exists(root):
+        import shutil
+
+        shutil.rmtree(root)
     zdir = os.path.join(root, ZERO_DIR)
     os.makedirs(zdir, exist_ok=True)
     for name, arr in params_flat.items():
@@ -98,6 +116,16 @@ def _write_universal(out_dir: str, tag: str, params_flat: Dict[str, np.ndarray],
     }
     with open(os.path.join(root, UNIVERSAL_META), "w") as f:
         json.dump(meta, f, indent=2)
+    if os.path.exists(final_root):
+        import shutil
+
+        shutil.rmtree(final_root)
+    os.replace(root, final_root)
+    root = final_root
+    if multi:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"universal_save:{tag}")
     with open(os.path.join(out_dir, LATEST_FILENAME), "w") as f:
         f.write(tag)
     return root
@@ -143,8 +171,10 @@ def save_universal_checkpoint(engine, save_dir: str, tag: Optional[str] = None) 
     native-save-then-convert round trip the reference requires)."""
     import jax
 
+    from ..runtime.checkpoint_engine import _to_host
+
     tag = str(tag) if tag is not None else f"global_step{engine.global_steps}"
-    params_host = jax.device_get(engine.params)
+    params_host = _to_host(engine.params)  # multi-host safe (allgathers non-addressable shards)
     params_flat = flat_named_leaves(params_host)
     sig = leaf_signature(params_host)
     offload = getattr(engine, "_host_offload", None)
@@ -152,7 +182,7 @@ def save_universal_checkpoint(engine, save_dir: str, tag: Optional[str] = None) 
         moments = [flat_named_leaves(to_state_dict(t)) for t in offload.moments_trees()]
         scalar_state = {"__offload_step__": np.asarray(offload.step_count)}
     else:
-        opt_state_sd = to_state_dict(jax.device_get(engine.opt_state))
+        opt_state_sd = to_state_dict(_to_host(engine.opt_state))
         paths = find_param_shaped_subtrees(opt_state_sd, sig)
         moments = []
         for p in paths:
@@ -215,8 +245,10 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         meta = json.load(f)
     names: List[str] = meta["param_names"]
 
+    from ..runtime.checkpoint_engine import _to_host
+
     # --- parameters ---
-    template_host = jax.device_get(engine.params)
+    template_host = _to_host(engine.params)
     tmpl_flat = flat_named_leaves(template_host)
     missing = [n for n in tmpl_flat if n not in names]
     if missing:
@@ -259,7 +291,7 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         return root
 
     if load_optimizer_states:
-        opt_host = jax.device_get(engine.opt_state)
+        opt_host = _to_host(engine.opt_state)
         opt_sd = to_state_dict(opt_host)
         sig = leaf_signature(template_host)
         paths = find_param_shaped_subtrees(opt_sd, sig)

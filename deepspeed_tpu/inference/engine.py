@@ -17,7 +17,6 @@ from .. import comm as dist
 from ..module_inject.auto_tp import get_tp_rules
 from ..parallel.mesh import MeshTopology, initialize_mesh
 from ..runtime.config import MeshConfig
-from ..runtime.zero.partition import specs_to_shardings
 from ..utils.logging import log_dist, logger
 from .config import DeepSpeedInferenceConfig
 
@@ -45,20 +44,11 @@ class InferenceEngine:
                 raise ValueError("init_inference needs params= (the parameter pytree)")
 
         # "kernel injection": shard per rules; kernels dispatch via the registry
-        from jax.sharding import PartitionSpec as P
+        from ..module_inject.load_checkpoint import tp_shardings
 
-        rules = get_tp_rules(params, tp, model if self._config.replace_method == "auto" else None)
-        self._rules = rules
-
-        from ..runtime.zero.partition import match_partition_rule
-
-        def leaf_spec(path, leaf):
-            names = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-            s = match_partition_rule(names, rules)
-            return s if s is not None else P()
-
-        specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
-        self.param_shardings = specs_to_shardings(specs, self.topology)
+        self._rules = get_tp_rules(params, tp, model if self._config.replace_method == "auto" else None)
+        self.param_shardings = tp_shardings(params, model if self._config.replace_method == "auto" else None,
+                                            mesh=self.topology, tp_size=tp)
         cast = lambda x: x.astype(self.dtype) if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.device_put(jax.tree_util.tree_map(cast, params), self.param_shardings)
 
@@ -68,69 +58,20 @@ class InferenceEngine:
         log_dist(f"InferenceEngine: tp={tp} dtype={self._config.dtype} max_out_tokens={self._max_len}", ranks=[0])
 
     # ------------------------------------------------------------------
-    def _build_fns(self):
-        model = self.module
-        max_len = self._max_len
-
-        def prefill(params, input_ids, caches):
-            B, S = input_ids.shape
-            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-            logits, caches = model.apply(params, input_ids, positions=positions, kv_caches=caches)
-            return logits[:, -1, :], caches
-
-        def decode_step(params, token, caches):
-            B = token.shape[0]
-            cache_len = caches[0][2]
-            positions = jnp.full((B, 1), cache_len, jnp.int32)
-            logits, caches = model.apply(params, token, positions=positions, kv_caches=caches)
-            return logits[:, -1, :], caches
-
-        self._prefill_fn = jax.jit(prefill, donate_argnums=(2,))
-        self._decode_fn = jax.jit(decode_step, donate_argnums=(2,))
-
-    @staticmethod
-    def _sample(logits, rng, do_sample: bool, temperature: float, top_k: int):
-        if not do_sample or temperature == 0.0:
-            return jnp.argmax(logits, axis=-1)
-        logits = logits / jnp.maximum(temperature, 1e-6)
-        if top_k > 0:
-            vals, _ = jax.lax.top_k(logits, top_k)
-            kth = vals[:, -1][:, None]
-            logits = jnp.where(logits < kth, -jnp.inf, logits)
-        return jax.random.categorical(rng, logits, axis=-1)
-
     def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False, temperature: float = 1.0,
                  top_k: int = 0, eos_token_id: Optional[int] = None, seed: int = 0, **kwargs):
         """Greedy/sampling decode. Reference ``engine.py:613 _generate``."""
+        from .generation import build_step_fns, generate_tokens
+
         if self._prefill_fn is None:
-            self._build_fns()
-        input_ids = jnp.asarray(input_ids, jnp.int32)
-        if input_ids.ndim == 1:
-            input_ids = input_ids[None]
-        B, S = input_ids.shape
-        max_len = S + max_new_tokens
-        if max_len > self._max_len:
+            self._prefill_fn, self._decode_fn = build_step_fns(self.module)
+        S = jnp.asarray(input_ids).shape[-1]
+        if S + max_new_tokens > self._max_len:
             raise ValueError(f"prompt {S} + max_new_tokens {max_new_tokens} exceeds max_out_tokens {self._max_len}")
-
-        caches = self.module.init_kv_caches(B, self._max_len, dtype=self.dtype)
-        rng = jax.random.PRNGKey(seed)
-        logits, caches = self._prefill_fn(self.params, input_ids, caches)
-
-        out = [input_ids]
-        finished = jnp.zeros((B,), bool)
-        token = None
-        for i in range(max_new_tokens):
-            rng, step_rng = jax.random.split(rng)
-            token = self._sample(logits, step_rng, do_sample, temperature, top_k)[:, None]
-            if eos_token_id is not None:
-                token = jnp.where(finished[:, None], eos_token_id, token)
-                finished = finished | (token[:, 0] == eos_token_id)
-            out.append(token)
-            if eos_token_id is not None and bool(jnp.all(finished)):
-                break
-            if i < max_new_tokens - 1:
-                logits, caches = self._decode_fn(self.params, token, caches)
-        return jnp.concatenate(out, axis=1)
+        return generate_tokens(self.module, self.params, self._prefill_fn, self._decode_fn, input_ids,
+                               max_new_tokens=max_new_tokens, cache_len=self._max_len, cache_dtype=self.dtype,
+                               do_sample=do_sample, temperature=temperature, top_k=top_k,
+                               eos_token_id=eos_token_id, seed=seed)
 
     def forward(self, input_ids, **kwargs):
         return self.module.apply(self.params, jnp.asarray(input_ids, jnp.int32))

@@ -1,0 +1,72 @@
+"""Shared KV-cache generation machinery.
+
+One implementation of the compiled prefill/decode pair + sampling +
+greedy/sampled decode loop, used by the v1 :class:`InferenceEngine`
+(reference ``inference/engine.py:613 _generate``) and the RLHF
+:class:`~deepspeed_tpu.runtime.hybrid_engine.DeepSpeedHybridEngine`
+(reference ``runtime/hybrid_engine.py:174 generate``) — the reference
+duplicates this loop per engine; keeping it single-sourced here means a
+sampling fix lands everywhere.
+"""
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def build_step_fns(model) -> Tuple:
+    """Jitted (prefill, decode_step) over ``model.apply`` with donated caches."""
+
+    def prefill(params, input_ids, caches):
+        B, S = input_ids.shape
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        logits, caches = model.apply(params, input_ids, positions=positions, kv_caches=caches)
+        return logits[:, -1, :], caches
+
+    def decode_step(params, token, caches):
+        B = token.shape[0]
+        cache_len = caches[0][2]
+        positions = jnp.full((B, 1), cache_len, jnp.int32)
+        logits, caches = model.apply(params, token, positions=positions, kv_caches=caches)
+        return logits[:, -1, :], caches
+
+    return jax.jit(prefill, donate_argnums=(2,)), jax.jit(decode_step, donate_argnums=(2,))
+
+
+def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int):
+    if not do_sample or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        vals, _ = jax.lax.top_k(logits, top_k)
+        logits = jnp.where(logits < vals[:, -1][:, None], -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate_tokens(model, params, prefill_fn, decode_fn, input_ids, *, max_new_tokens: int, cache_len: int,
+                    cache_dtype, do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
+                    eos_token_id: Optional[int] = None, seed: int = 0):
+    """Prefill + per-token decode loop; returns (B, S + new) token ids."""
+    input_ids = jnp.asarray(input_ids, jnp.int32)
+    if input_ids.ndim == 1:
+        input_ids = input_ids[None]
+    B = input_ids.shape[0]
+    caches = model.init_kv_caches(B, cache_len, dtype=cache_dtype)
+    rng = jax.random.PRNGKey(seed)
+    logits, caches = prefill_fn(params, input_ids, caches)
+
+    out = [input_ids]
+    finished = jnp.zeros((B,), bool)
+    for i in range(max_new_tokens):
+        rng, step_rng = jax.random.split(rng)
+        token = sample_logits(logits, step_rng, do_sample, temperature, top_k)[:, None]
+        if eos_token_id is not None:
+            token = jnp.where(finished[:, None], eos_token_id, token)
+            finished = finished | (token[:, 0] == eos_token_id)
+        out.append(token)
+        if eos_token_id is not None and bool(jnp.all(finished)):
+            break
+        if i < max_new_tokens - 1:
+            logits, caches = decode_fn(params, token, caches)
+    return jnp.concatenate(out, axis=1)

@@ -56,8 +56,14 @@ class RaggedInferenceEngineConfig:
 
 class InferenceEngineV2:
 
-    def __init__(self, model, params, config: Optional[RaggedInferenceEngineConfig] = None):
-        """``model`` is a ``CausalLM`` (or anything exposing ``.cfg``)."""
+    def __init__(self, model, params, config: Optional[RaggedInferenceEngineConfig] = None, mesh=None):
+        """``model`` is a ``CausalLM`` (or anything exposing ``.cfg``).
+
+        ``config.tensor_parallel > 1`` serves TP-sharded (reference
+        ``v2/model_implementations/sharding/``): params shard per the
+        model's partition rules, KV pages split over heads, and the
+        decode kernel runs under shard_map on the ``tensor`` axis.
+        """
         if config is None:
             config = RaggedInferenceEngineConfig()
         elif isinstance(config, dict):
@@ -67,6 +73,21 @@ class InferenceEngineV2:
         cfg: TransformerConfig = model.cfg
         self.cfg = cfg
         self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else jnp.float32
+
+        self._tp = int(config.tensor_parallel)
+        self._mesh_topo = None
+        if self._tp > 1:
+            from ...parallel.mesh import MeshTopology, initialize_mesh
+            from ...runtime.config import MeshConfig
+
+            self._mesh_topo = mesh if isinstance(mesh, MeshTopology) else \
+                initialize_mesh(MeshConfig.from_dict({"data": -1, "tensor": self._tp}))
+            if self._mesh_topo.model_parallel_size != self._tp:
+                raise ValueError(f"mesh tensor axis {self._mesh_topo.model_parallel_size} != "
+                                 f"tensor_parallel {self._tp}")
+            if cfg.kv_heads % self._tp or cfg.n_heads % self._tp:
+                raise ValueError(f"n_heads {cfg.n_heads} and kv_heads {cfg.kv_heads} must be divisible by "
+                                 f"tp={self._tp}")
 
         smc = config.state_manager
         if smc.max_context > cfg.max_seq_len:
@@ -96,11 +117,22 @@ class InferenceEngineV2:
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         run_cfg = dataclasses.replace(cfg, dtype=self.dtype)
         self.params = jax.tree_util.tree_map(cast, params)
+        if self._tp > 1:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ...module_inject.load_checkpoint import shard_params
+
+            self.params = shard_params(self.params, self.model, mesh=self._mesh_topo, tp_size=self._tp)
+            page_sharding = NamedSharding(self._mesh_topo.mesh, P(None, None, None, "tensor", None))
+            self.k_pages = jax.device_put(self.k_pages, page_sharding)
+            self.v_pages = jax.device_put(self.v_pages, page_sharding)
         interpret = config.interpret_kernels
         if interpret is None:
             from ...ops.registry import pallas_available
             interpret = not pallas_available()
-        self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret)
+        self._prefill_fn, self._decode_fn = make_step_fns(
+            run_cfg, interpret=interpret,
+            mesh=self._mesh_topo.mesh if self._mesh_topo is not None else None, tp=self._tp)
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
                  f"({n_blocks * bs} cached tokens), dtype={config.dtype}", ranks=[0])
 

@@ -12,15 +12,25 @@ Two jitted programs per model:
   with history), KV written to pages via slot mapping.
 - ``decode``: (B, 1) tokens, one per sequence; Pallas paged decode.
 
-MoE blocks are not yet supported in the v2 runner (the training/MoE path
-covers them); raise early instead of silently miscomputing.
+MoE blocks route through the same top-k gate + dispatch/combine einsums
+as training, but with ``drop_tokens=False`` — serving must never drop a
+token (reference ragged MoE kernels,
+``inference/v2/kernels/ragged_ops/{moe_scatter,moe_gather,top_k_gating}``).
+
+Tensor parallelism (reference ``v2/model_implementations/sharding/``):
+with ``mesh``/``tp`` set, the projections/MLP/MoE partition under GSPMD
+from the params' shardings, and the Pallas decode kernel runs under
+``shard_map`` with heads split over the ``tensor`` axis (paged attention
+is embarrassingly parallel over heads).
 """
 
 import functools
-from typing import Any, Dict, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import TransformerConfig, rope_frequencies
 from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_ref, update_kv_pages)
@@ -60,10 +70,55 @@ def _mlp(x: jnp.ndarray, p: Dict[str, Any], activation: str, dtype) -> jnp.ndarr
     return _proj(h, p["down_proj"], "bsf,fd->bsd", dtype)
 
 
+def _moe(x: jnp.ndarray, p: Dict[str, Any], cfg: TransformerConfig, dtype) -> jnp.ndarray:
+    """MoE FFN in serving mode — ragged grouped matmuls, never dropping a
+    token (the reference's ``moe_scatter``/``moe_gather``/``top_k_gating``
+    ragged kernels, ``inference/v2/kernels/ragged_ops/``).
+
+    Tokens sort by expert and run through ``lax.ragged_dot`` grouped
+    GEMMs: O(N*k) memory, vs the training layer's capacity-dense
+    (N, E, C) dispatch which is quadratic in N when no-drop forces C=N.
+    Output math matches the training gate exactly (top-1 uses the raw
+    softmax prob; top-k>1 normalizes the k weights), so serving equals
+    the dense oracle."""
+    B, S, d = x.shape
+    k, E = cfg.moe_top_k, cfg.moe_num_experts
+    tokens = x.reshape(-1, d)
+    N = tokens.shape[0]
+    gates = jax.nn.softmax(tokens.astype(jnp.float32) @ p["gate"]["kernel"].astype(jnp.float32), axis=-1)
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # (N, k)
+    if k > 1:  # training parity: topkgating normalizes, top1gating does not
+        topk_vals = topk_vals / jnp.maximum(jnp.sum(topk_vals, axis=-1, keepdims=True), 1e-9)
+
+    flat_e = topk_idx.reshape(-1)  # (N*k,)
+    order = jnp.argsort(flat_e)  # stable: preserves token order within an expert
+    tok_of = order // k
+    xs = tokens[tok_of].astype(dtype)  # (N*k, d) sorted by expert
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    ep = p["experts"]
+    h = jax.lax.ragged_dot(xs, ep["wi"].astype(dtype), group_sizes)
+    if cfg.activation == "swiglu":
+        g = jax.lax.ragged_dot(xs, ep["wg"].astype(dtype), group_sizes)
+        h = jax.nn.silu(g) * h
+    else:
+        h = jax.nn.gelu(h)
+    out_s = jax.lax.ragged_dot(h, ep["wo"].astype(dtype), group_sizes)  # (N*k, d)
+
+    w_flat = topk_vals.reshape(-1)[order].astype(dtype)
+    out = jnp.zeros((N, d), dtype).at[tok_of].add(out_s * w_flat[:, None])
+    return out.reshape(B, S, d)
+
+
+def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
+    freq = max(1, cfg.moe_layer_freq)
+    return cfg.moe_num_experts > 0 and (i % freq == freq - 1)
+
+
 def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
                    k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray, ctx_lens: jnp.ndarray,
                    slot_mapping: jnp.ndarray, last_token_idx: jnp.ndarray, *, decode: bool,
-                   interpret: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                   interpret: bool = False, mesh=None, tp: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One engine step over the paged cache.
 
     input_ids/positions: (B, S); k_pages/v_pages: (L, N, bs, KVH, D);
@@ -72,11 +127,20 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     last_token_idx: (B,) index of the last real (non-pad) token per row.
     Returns (last-real-token logits (B, V), k_pages, v_pages).
     """
-    if cfg.moe_num_experts > 0:
-        raise NotImplementedError("MoE models are not yet supported by the v2 ragged runner")
     B, S = input_ids.shape
     H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     dtype = cfg.dtype
+
+    if mesh is not None and tp > 1:
+        # heads split over `tensor`: each shard decodes its own heads
+        # against its KV-page shard (ref v2 sharding helpers)
+        decode_attn = shard_map(
+            functools.partial(paged_attention_decode, interpret=interpret),
+            mesh=mesh, in_specs=(P(None, "tensor", None), P(None, None, "tensor", None),
+                                 P(None, None, "tensor", None), P(None, None), P(None)),
+            out_specs=P(None, "tensor", None), check_vma=False)
+    else:
+        decode_attn = functools.partial(paged_attention_decode, interpret=interpret)
 
     x = params["wte"][input_ids].astype(dtype)
     if cfg.pos_emb == "learned":
@@ -102,12 +166,15 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         v_pages = v_pages.at[i].set(vp)
 
         if decode:
-            attn = paged_attention_decode(q[:, 0], kp, vp, block_tables, ctx_lens, interpret=interpret)[:, None]
+            attn = decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
         else:
             attn = paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions)
         x = x + _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
         h2 = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
-        x = x + _mlp(h2, lp["mlp"], cfg.activation, dtype)
+        if _is_moe_layer(cfg, i):
+            x = x + _moe(h2, lp["moe"], cfg, dtype)
+        else:
+            x = x + _mlp(h2, lp["mlp"], cfg.activation, dtype)
 
     x = _norm(x, params[f"{norm_key}_0"], cfg.norm_eps, dtype)
     last = x[jnp.arange(B), last_token_idx, :]
@@ -118,10 +185,10 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     return logits.astype(jnp.float32), k_pages, v_pages
 
 
-def make_step_fns(cfg: TransformerConfig, interpret: bool = False):
+def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
     """Jitted (prefill_fn, decode_fn) with donated page buffers."""
-    prefill = jax.jit(functools.partial(ragged_forward, cfg, decode=False, interpret=interpret),
+    prefill = jax.jit(functools.partial(ragged_forward, cfg, decode=False, interpret=interpret, mesh=mesh, tp=tp),
                       donate_argnums=(3, 4), static_argnames=())
-    decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret),
+    decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp),
                      donate_argnums=(3, 4), static_argnames=())
     return prefill, decode

@@ -1,0 +1,4 @@
+from .config import LoRAConfig, QuantizationConfig
+from .optimized_linear import OptimizedLinear, fuse_lora_tree, unfuse_lora_tree
+
+__all__ = ["LoRAConfig", "QuantizationConfig", "OptimizedLinear", "fuse_lora_tree", "unfuse_lora_tree"]

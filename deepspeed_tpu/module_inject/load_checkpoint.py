@@ -263,8 +263,11 @@ def load_hf_checkpoint(model_dir: str, dtype=None, mesh=None, shard: bool = Fals
     return model, params
 
 
-def shard_params(params: Dict, model=None, mesh=None, tp_size: Optional[int] = None):
-    """Device-put a host param tree with TP rules applied (born sharded)."""
+def tp_shardings(params: Dict, model=None, mesh=None, tp_size: Optional[int] = None):
+    """NamedShardings for a serving layout: TP rules over the ``tensor``
+    axis when ``tp > 1``, fully replicated otherwise. The ONE mapping from
+    TP rules to shardings — used by the v1 engine, v2 engine, hybrid
+    engine, and :func:`shard_params` so layouts cannot drift."""
     import jax
     from jax.sharding import PartitionSpec as P
 
@@ -274,15 +277,25 @@ def shard_params(params: Dict, model=None, mesh=None, tp_size: Optional[int] = N
 
     topo = mesh if mesh is not None else get_mesh_topology()
     tp = tp_size or topo.model_parallel_size
-    rules = get_tp_rules(params, tp, model)
+    if tp <= 1:
+        specs = jax.tree_util.tree_map(lambda _: P(), params)
+    else:
+        rules = get_tp_rules(params, tp, model)
 
-    def leaf_spec(path, leaf):
-        names = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
-        s = match_partition_rule(names, rules)
-        return s if s is not None else P()
+        def leaf_spec(path, leaf):
+            names = tuple(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+            s = match_partition_rule(names, rules)
+            return s if s is not None else P()
 
-    specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
-    return jax.device_put(params, specs_to_shardings(specs, topo))
+        specs = jax.tree_util.tree_map_with_path(leaf_spec, params)
+    return specs_to_shardings(specs, topo)
+
+
+def shard_params(params: Dict, model=None, mesh=None, tp_size: Optional[int] = None):
+    """Device-put a host param tree with TP rules applied (born sharded)."""
+    import jax
+
+    return jax.device_put(params, tp_shardings(params, model, mesh=mesh, tp_size=tp_size))
 
 
 def _flat_leaves(tree):

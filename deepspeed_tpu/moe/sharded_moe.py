@@ -41,7 +41,9 @@ def top1gating(logits: jnp.ndarray, capacity_factor: float, min_capacity: int, r
                used_token_mask: Optional[jnp.ndarray] = None):
     """Top-1 (Switch) gating. logits: (N, E). Returns (l_aux, combine (N,E,C), dispatch (N,E,C), exp_counts)."""
     N, E = logits.shape
-    C = _capacity(N, E, capacity_factor, min_capacity, k=1)
+    # drop_tokens=False must hold the worst case (all tokens to one expert):
+    # C < N would silently zero overflow rows via the out-of-range one_hot
+    C = _capacity(N, E, capacity_factor, min_capacity, k=1) if drop_tokens else N
     if noisy_gate_policy == "RSample" and rng is not None:
         logits_w_noise = logits + jax.random.normal(rng, logits.shape, logits.dtype)
     else:
@@ -76,7 +78,9 @@ def topkgating(logits: jnp.ndarray, k: int, capacity_factor: float, min_capacity
                drop_tokens: bool = True, normalize_weights: bool = True):
     """General top-k gating (k=2 reproduces GShard top-2). logits: (N, E)."""
     N, E = logits.shape
-    C = _capacity(N, E, capacity_factor, min_capacity, k)
+    # see top1gating: no-drop mode needs room for every token per expert,
+    # or the clip at C-1 sums overflow tokens into one corrupted slot
+    C = _capacity(N, E, capacity_factor, min_capacity, k) if drop_tokens else N
     gates = jax.nn.softmax(logits, axis=-1)
 
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # (N, k)

@@ -3,18 +3,30 @@
 Parity: reference ``runtime/checkpoint_engine/`` (``CheckpointEngine`` ABC,
 torch + Nebula-async implementations). Here:
 
-- ``MsgpackCheckpointEngine`` — default: flax.serialization msgpack of full
-  (unsharded) pytrees. The layout is sharding-agnostic by construction —
-  the "universal checkpoint" property the reference needs an offline
-  converter for (``checkpoint/ds_to_universal.py``) is the native format.
-- ``OrbaxCheckpointEngine`` — async/tensorstore-backed sharded save for
-  large models (the Nebula-async analogue), used when available.
+- ``MsgpackCheckpointEngine`` — default single-host engine:
+  flax.serialization msgpack of full (unsharded) pytrees, written
+  atomically (tmp + rename). The layout is sharding-agnostic by
+  construction — the "universal checkpoint" property the reference needs
+  an offline converter for (``checkpoint/ds_to_universal.py``) is the
+  native format. Multi-host safe: non-addressable shards are gathered
+  via ``process_allgather`` before serialization (every host sees the
+  full tree; process 0 writes).
+- ``OrbaxCheckpointEngine`` — tensorstore-backed sharded writes: every
+  process writes exactly its own shards (the multi-host-scalable path),
+  async when ``use_async`` (Nebula analogue).
+- ``AsyncCheckpointEngine`` — wraps any engine: the device->host snapshot
+  happens synchronously (so training may mutate params immediately
+  after), serialization + disk I/O run on a background thread, and
+  ``commit`` returns without joining — the write overlaps the next
+  training steps. ``wait()`` drains; loads wait automatically.
 """
 
 import json
 import os
 import pickle
-from typing import Any, Dict, Optional
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -38,15 +50,41 @@ class CheckpointEngine:
     def commit(self, tag: str) -> bool:
         return True
 
+    def wait(self):
+        """Block until every pending (async) write is durable."""
+        return None
+
+    def prepare_template(self, tree):
+        """Shape a live (possibly multi-host-sharded) tree into the
+        template this engine's ``load`` wants. Default: host numpy
+        (multi-host-safe via allgather)."""
+        return _to_host(tree)
+
     def makedirs(self, path: str, exist_ok: bool = True):
         os.makedirs(path, exist_ok=exist_ok)
 
 
 def _to_host(tree):
-    """Gather every leaf to host memory as numpy (sharding-agnostic)."""
+    """Gather every leaf to host memory as numpy (sharding-agnostic).
+
+    Multi-host safe: a leaf whose shards live partly on other processes
+    (``not x.is_fully_addressable``) is allgathered across processes
+    first (reference engines have each rank write its own shard; the
+    msgpack full-tree format needs the whole array on the writer).
+    """
+    gather = None
 
     def leaf(x):
+        nonlocal gather
         if isinstance(x, jax.Array):
+            if not x.is_fully_addressable:
+                if gather is None:
+                    from jax.experimental import multihost_utils
+
+                    gather = multihost_utils.process_allgather
+                # tiled: reassemble the global array (non-tiled would stack
+                # a process dim; also the only mode jax supports here)
+                return np.asarray(gather(x, tiled=True))
             return np.asarray(jax.device_get(x))
         return x
 
@@ -55,18 +93,29 @@ def _to_host(tree):
 
 class MsgpackCheckpointEngine(CheckpointEngine):
     def save(self, state: Dict[str, Any], path: str):
+        self._write_host(_to_host(state), path)
+
+    def _write_host(self, host_state, path: str):
+        """Serialize + atomic write; only process 0 touches the file
+        (every process holds the full host tree after _to_host)."""
         from flax import serialization
 
+        if jax.process_index() != 0:
+            return
         self.makedirs(os.path.dirname(path))
-        host_state = _to_host(state)
+        tmp = f"{path}.tmp-{os.getpid()}"
         try:
-            blob = serialization.to_bytes(host_state)
-            with open(path, "wb") as f:
-                f.write(b"MSGP" + blob)
-        except Exception:
-            # fall back to pickle for exotic leaves (python scalars, configs)
-            with open(path, "wb") as f:
-                f.write(b"PICK" + pickle.dumps(host_state))
+            try:
+                blob = b"MSGP" + serialization.to_bytes(host_state)
+            except Exception:
+                # fall back to pickle for exotic leaves (python scalars, configs)
+                blob = b"PICK" + pickle.dumps(host_state)
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
 
     def load(self, path: str, template: Optional[Any] = None, map_location=None):
         from flax import serialization
@@ -83,20 +132,39 @@ class MsgpackCheckpointEngine(CheckpointEngine):
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
-    """Sharded/async save via orbax (tensorstore). Best for multi-host and
-    models too large to gather on one host."""
+    """Sharded (tensorstore) writes: each process persists only its own
+    shards — the multi-host path for models too large to gather. With
+    ``use_async`` the write runs in orbax's background thread and
+    ``commit``/``wait`` finalize it (the reference's Nebula engine)."""
 
-    def __init__(self, config_params=None):
+    def __init__(self, config_params=None, use_async: bool = False):
         super().__init__(config_params)
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
-        self._ckptr = ocp.PyTreeCheckpointer()
+        self._async = use_async
+        self._ckptr = ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler()) if use_async \
+            else ocp.PyTreeCheckpointer()
 
     def save(self, state: Dict[str, Any], path: str):
         self._ckptr.save(os.path.abspath(path), state, force=True)
 
+    def wait(self):
+        if self._async:
+            self._ckptr.wait_until_finished()
+
+    def commit(self, tag: str) -> bool:
+        # async: the in-flight tensorstore write keeps overlapping training;
+        # loads and the next save wait for it
+        return True
+
+    def prepare_template(self, tree):
+        # keep live sharded arrays: restore_args reads only this process's
+        # shards back into the same shardings (never a full-host gather)
+        return tree
+
     def load(self, path: str, template: Optional[Any] = None, map_location=None):
+        self.wait()
         if template is not None:
             restore_args = jax.tree_util.tree_map(
                 lambda x: self._ocp.ArrayRestoreArgs(sharding=x.sharding)
@@ -105,11 +173,79 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         return self._ckptr.restore(os.path.abspath(path))
 
 
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Background-commit wrapper (reference ``NebulaCheckpointEngine``):
+    ``save`` snapshots device state to host synchronously — the cheap,
+    correctness-critical part — then hands serialization + disk I/O to a
+    worker thread and returns. Training proceeds while bytes hit disk;
+    ``wait()`` (called by ``load``) drains."""
+
+    def __init__(self, config_params=None, base: Optional[CheckpointEngine] = None):
+        super().__init__(config_params)
+        self.base = base or MsgpackCheckpointEngine(config_params)
+        self._executor = ThreadPoolExecutor(max_workers=2, thread_name_prefix="ckpt-write")
+        self._pending: List[Future] = []
+        self._lock = threading.Lock()
+
+    def save(self, state: Dict[str, Any], path: str):
+        if isinstance(self.base, MsgpackCheckpointEngine):
+            host_state = _to_host(state)  # snapshot NOW; params may move next step
+            fut = self._executor.submit(self.base._write_host, host_state, path)
+        else:
+            # orbax async is already backgrounded after its own snapshot
+            fut = self._executor.submit(self.base.save, state, path)
+        with self._lock:
+            self._pending.append(fut)
+
+    def wait(self):
+        with self._lock:
+            pending, self._pending = self._pending, []
+        errors = []
+        for fut in pending:
+            try:
+                fut.result()
+            except Exception as e:  # drain EVERY write before surfacing
+                errors.append(e)
+        self.base.wait()
+        if errors:
+            if len(errors) == 1:
+                raise errors[0]
+            raise RuntimeError(f"{len(errors)} checkpoint writes failed: {errors}")
+
+    def commit(self, tag: str) -> bool:
+        # deliberately non-blocking: the overlap with subsequent training
+        # steps is the point; durability via wait()
+        return True
+
+    def load(self, path: str, template: Optional[Any] = None, map_location=None):
+        self.wait()
+        return self.base.load(path, template=template, map_location=map_location)
+
+    def prepare_template(self, tree):
+        return self.base.prepare_template(tree)
+
+    def makedirs(self, path: str, exist_ok: bool = True):
+        self.base.makedirs(path, exist_ok=exist_ok)
+
+
 def create_checkpoint_engine(config=None) -> CheckpointEngine:
-    name = os.environ.get("DS_TPU_CKPT_ENGINE", "msgpack")
+    """Select by ``checkpoint.engine`` config (env ``DS_TPU_CKPT_ENGINE``
+    overrides): auto -> orbax sharded writes when multi-process, msgpack
+    otherwise; ``checkpoint.async_save`` adds the background commit."""
+    ckpt_cfg = getattr(config, "checkpoint_config", None)
+    name = (os.environ.get("DS_TPU_CKPT_ENGINE") or getattr(ckpt_cfg, "engine", "auto")).lower()
+    async_save = bool(getattr(ckpt_cfg, "async_save", False))
+    if name not in ("auto", "orbax", "msgpack"):
+        raise ValueError(f"unknown checkpoint engine {name!r}: expected auto | orbax | msgpack")
+    if name == "auto":
+        name = "orbax" if jax.process_count() > 1 else "msgpack"
     if name == "orbax":
         try:
-            return OrbaxCheckpointEngine(config)
+            base = OrbaxCheckpointEngine(config, use_async=async_save)
+            return base  # orbax handles async internally
         except Exception as e:
             logger.warning(f"orbax unavailable ({e}); using msgpack engine")
-    return MsgpackCheckpointEngine(config)
+    base = MsgpackCheckpointEngine(config)
+    if async_save:
+        return AsyncCheckpointEngine(config, base=base)
+    return base

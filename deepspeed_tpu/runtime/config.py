@@ -205,6 +205,19 @@ class PipelineConfig(DeepSpeedConfigModel):
 
 
 @dataclass
+class HybridEngineConfig(DeepSpeedConfigModel):
+    """RLHF hybrid engine (reference ``runtime/hybrid_engine.py`` config):
+    one engine flipping between ZeRO training and TP inference over the
+    same live weights."""
+    enabled: bool = False
+    max_out_tokens: int = ds_field(512, ge=1)
+    inference_tp_size: int = ds_field(1, ge=1)
+    release_inference_cache: bool = False
+    pin_parameters: bool = True  # n/a on TPU (no pinned host staging); kept for config parity
+    tp_gather_partition_size: int = ds_field(8, ge=1)
+
+
+@dataclass
 class MeshConfig(DeepSpeedConfigModel):
     """TPU-native: named mesh-axis sizes replacing the reference's mpu/rank-grid.
 
@@ -242,6 +255,10 @@ class CheckpointConfig(DeepSpeedConfigModel):
     use_node_local_storage: bool = False
     parallel_write_pipeline: bool = False
     async_save: bool = False
+    # msgpack | orbax | auto ("auto": orbax when multi-process — per-shard
+    # tensorstore writes — else msgpack). async_save wraps either with the
+    # background-commit engine (reference Nebula analogue).
+    engine: str = "auto"
 
 
 @dataclass
@@ -328,6 +345,7 @@ class DeepSpeedConfig:
         self.wandb = WandbConfig.from_dict(d.get("wandb", {}))
         self.csv_monitor = CSVConfig.from_dict(d.get("csv_monitor", {}))
         self.pipeline = PipelineConfig.from_dict(d.get("pipeline", {}))
+        self.hybrid_engine = HybridEngineConfig.from_dict(d.get("hybrid_engine", {}))
         self.mesh = MeshConfig.from_dict(d.get("mesh", mesh_shape or {}))
         # MiCS sugar (reference runtime/zero/mics.py): mics_shard_size=k IS
         # the mesh layout {fsdp: k, data: replicas}; size fsdp if unset.

@@ -626,7 +626,7 @@ class DeepSpeedEngine:
                 tag = f.read().strip()
         d = self._ckpt_dir(load_dir, tag)
         params_host = self.checkpoint_engine.load(os.path.join(d, MODEL_STATES_FILENAME),
-                                                  template=jax.device_get(self.params))
+                                                  template=self.checkpoint_engine.prepare_template(self.params))
         self.params = jax.device_put(params_host, self.param_shardings)
         if self._host_offload is not None:
             # keep the host master copies in sync even when optimizer states
@@ -643,7 +643,8 @@ class DeepSpeedEngine:
                     "lr_scheduler": self.lr_scheduler.state_dict() if self.lr_scheduler is not None else None,
                     "global_steps": 0, "micro_steps": 0, "global_samples": 0, "skipped_steps": 0,
                 }
-                state = self.checkpoint_engine.load(optim_path, template=jax.device_get(template))
+                state = self.checkpoint_engine.load(optim_path,
+                                                    template=self.checkpoint_engine.prepare_template(template))
                 if self._host_offload is not None:
                     self._host_offload.load_state_dict(state["opt_state"])
                 else:
@@ -707,6 +708,14 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None, tra
         engine = PipelineEngine(args=args, model=model, optimizer=optimizer, model_parameters=model_parameters,
                                 training_data=training_data, lr_scheduler=lr_scheduler, mesh=mesh,
                                 dist_init_required=dist_init_required, collate_fn=collate_fn, config=cfg, **kwargs)
+    elif cfg.hybrid_engine.enabled:
+        from .hybrid_engine import DeepSpeedHybridEngine
+
+        engine = DeepSpeedHybridEngine(args=args, model=model, optimizer=optimizer,
+                                       model_parameters=model_parameters, training_data=training_data,
+                                       lr_scheduler=lr_scheduler, mesh=mesh,
+                                       dist_init_required=dist_init_required, collate_fn=collate_fn, config=cfg,
+                                       **kwargs)
     else:
         engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer, model_parameters=model_parameters,
                                  training_data=training_data, lr_scheduler=lr_scheduler, mesh=mesh,

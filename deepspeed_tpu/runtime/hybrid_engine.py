@@ -1,0 +1,123 @@
+"""Hybrid engine: ZeRO training + generation over the same live weights.
+
+Parity: reference ``runtime/hybrid_engine.py`` (``DeepSpeedHybridEngine``
+:32) — the DeepSpeed-Chat RLHF engine that flips one model between
+ZeRO-3 training and injected-kernel inference, gathering partitioned
+params for generation (:174), populating inference containers that alias
+training weights (:280,306), and running a TP'd forward under ZeRO-3
+(:363).
+
+TPU-native shape: "sharing live training weights" is the natural state in
+SPMD — the training params ARE the inference params, just possibly laid
+out for training (fsdp-sharded). ``generate()`` reshards them once per
+actor-generation phase into the inference layout (replicated over
+data/fsdp, TP over ``tensor`` — the analogue of the reference's gather +
+TP containers), runs compiled prefill/decode against that copy, and
+releases it on the next training step (or immediately with
+``release_inference_cache``). Training state is untouched, so
+``train_batch`` after ``generate`` continues the exact trajectory —
+verified by the train→generate→train parity test.
+
+LoRA fuse/unfuse (:138-158) applies when the model carries
+``deepspeed_tpu.linear.OptimizedLinear`` adapters: generation uses the
+fused ``W + BA`` weights via ``linear.fuse_lora_tree`` so the decode
+matmul stays a single MXU op.
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist
+from .engine import DeepSpeedEngine, _cast_tree
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        he = self.config.hybrid_engine
+        self._he_cfg = he
+        self._gen_params = None
+        self._gen_at_step = -1
+        self._prefill_fn = None
+        self._decode_fn = None
+        if not hasattr(self.module, "init_kv_caches") or not hasattr(self.module, "apply"):
+            raise TypeError("hybrid engine needs a model with apply(params, ids, kv_caches=...) and "
+                            "init_kv_caches (models.CausalLM implements both)")
+        if he.inference_tp_size > 1 and self.topology.model_parallel_size != he.inference_tp_size:
+            # same contract as both inference engines: a silent mismatch
+            # would serve fully replicated (possible OOM) instead of TP'd
+            raise ValueError(f"mesh tensor axis {self.topology.model_parallel_size} != "
+                             f"hybrid_engine.inference_tp_size {he.inference_tp_size}")
+        log_dist(f"HybridEngine: max_out_tokens={he.max_out_tokens} "
+                 f"inference_tp={he.inference_tp_size}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _inference_shardings(self, params):
+        """Inference layout: TP rules over ``tensor``, replicated elsewhere
+        (the reference's allgather + TP-sharded containers, :280)."""
+        from ..module_inject.load_checkpoint import tp_shardings
+
+        return tp_shardings(params, self.module, mesh=self.topology, tp_size=self._he_cfg.inference_tp_size)
+
+    def _gen_weights(self):
+        """Current weights in inference layout; cached until the next
+        optimizer step invalidates them (reference: containers re-populated
+        per generate phase, :306)."""
+        if self._gen_params is not None and self._gen_at_step == self.global_steps:
+            return self._gen_params
+        from ..linear import fuse_lora_tree
+
+        params = _cast_tree(self.params, self.compute_dtype)
+        params = fuse_lora_tree(params)  # LoRA fuse (reference :138); no-op without adapters
+        self._gen_params = jax.device_put(params, self._inference_shardings(params))
+        self._gen_at_step = self.global_steps
+        return self._gen_params
+
+    def unfuse_lora_weight(self):
+        """Reference :148 — training params are never mutated here, so
+        unfuse = drop the fused inference copy."""
+        self.release_inference_cache()
+
+    def release_inference_cache(self):
+        self._gen_params = None
+        self._gen_at_step = -1
+
+    def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, eos_token_id: Optional[int] = None, seed: int = 0, **kwargs):
+        """Actor generation against the live training weights
+        (reference ``hybrid_engine.py:174 generate``)."""
+        from ..inference.generation import build_step_fns, generate_tokens
+
+        if self._prefill_fn is None:
+            self._prefill_fn, self._decode_fn = build_step_fns(self.module)
+        s = jnp.asarray(input_ids).shape[-1]
+        if s + max_new_tokens > self._he_cfg.max_out_tokens:
+            raise ValueError(f"prompt {s} + max_new_tokens {max_new_tokens} exceeds "
+                             f"hybrid_engine.max_out_tokens {self._he_cfg.max_out_tokens}")
+        result = generate_tokens(self.module, self._gen_weights(), self._prefill_fn, self._decode_fn, input_ids,
+                                 max_new_tokens=max_new_tokens, cache_len=self._he_cfg.max_out_tokens,
+                                 cache_dtype=self.compute_dtype, do_sample=do_sample, temperature=temperature,
+                                 top_k=top_k, eos_token_id=eos_token_id, seed=seed)
+        if self._he_cfg.release_inference_cache:
+            self.release_inference_cache()
+        return result
+
+    def step(self):
+        super().step()
+        # weights moved: the fused/resharded inference copy is stale
+        if self._gen_at_step != self.global_steps:
+            self._gen_params = None
+
+    def load_checkpoint(self, *args, **kwargs):
+        out = super().load_checkpoint(*args, **kwargs)
+        # loaded weights can share the cached copy's global_steps value —
+        # the step-keyed cache cannot see that; drop it explicitly
+        self.release_inference_cache()
+        return out
+
+    def load_universal_checkpoint(self, *args, **kwargs):
+        out = super().load_universal_checkpoint(*args, **kwargs)
+        self.release_inference_cache()
+        return out

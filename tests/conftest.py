@@ -38,3 +38,11 @@ def mesh8():
     from deepspeed_tpu.parallel.mesh import initialize_mesh
 
     return initialize_mesh(force=True)
+
+
+# make sibling test helpers (dist_utils) importable regardless of rootdir
+import sys as _sys  # noqa: E402
+
+_unit_dir = os.path.join(os.path.dirname(__file__), "unit")
+if _unit_dir not in _sys.path:
+    _sys.path.insert(0, _unit_dir)

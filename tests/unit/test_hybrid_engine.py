@@ -1,0 +1,98 @@
+"""Hybrid (RLHF) engine tests.
+
+Reference coverage model: ``tests/hybrid_engine/`` — generation against
+live ZeRO-3 training weights must not perturb the training trajectory,
+and must reflect the trained (not initial) weights.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import CausalLM, llama_tiny
+from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+
+
+def _cfg(enabled=True):
+    return {
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 3, "stage3_param_persistence_threshold": 0},
+        "mesh": {"data": 2, "fsdp": 2, "tensor": 2},
+        "hybrid_engine": {"enabled": enabled, "max_out_tokens": 64, "inference_tp_size": 2},
+        "steps_per_print": 10**9,
+    }
+
+
+def _make(enabled=True):
+    model = CausalLM(llama_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=_cfg(enabled))
+    return engine
+
+
+def _batches(n, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"input_ids": rng.randint(0, 1024, size=(4, 16)).astype(np.int32)} for _ in range(n)]
+
+
+def test_hybrid_engine_selected():
+    engine = _make()
+    assert isinstance(engine, DeepSpeedHybridEngine)
+
+
+def test_generate_does_not_perturb_training():
+    """train 2 -> generate -> train 2 must equal train 4 straight
+    (reference hybrid_engine contract: generation shares weights but
+    never moves them)."""
+    batches = _batches(4)
+    prompt = np.array([[1, 5, 9, 3]], dtype=np.int32)
+
+    def run(with_generate):
+        engine = _make()
+        losses = []
+        for i, b in enumerate(batches):
+            if with_generate and i == 2:
+                out = engine.generate(prompt, max_new_tokens=4)
+                assert out.shape == (1, 8)
+            loss = engine.forward(b)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return losses
+
+    base = run(False)
+    mixed = run(True)
+    np.testing.assert_allclose(base, mixed, rtol=1e-6, atol=0)
+
+
+def test_generate_uses_live_weights():
+    """Generation reflects training updates: logits-path weights after N
+    steps differ from init, and generate() picks them up (the reference
+    re-populates containers from the trained params each phase)."""
+    engine = _make()
+    prompt = np.array([[2, 7, 11, 4]], dtype=np.int32)
+    out0 = np.asarray(engine.generate(prompt, max_new_tokens=6, seed=1))
+    for b in _batches(3, seed=5):
+        loss = engine.forward(b)
+        engine.backward(loss)
+        engine.step()
+    out1 = np.asarray(engine.generate(prompt, max_new_tokens=6, seed=1))
+    # same seed/prompt: any difference must come from moved weights; with
+    # lr=1e-2 on a tiny model 3 steps almost surely change the argmax chain —
+    # but at minimum the cached inference copy must have been invalidated
+    assert engine._gen_at_step == engine.global_steps
+    oracle = deepspeed_tpu.init_inference(
+        engine.module, config={"dtype": "fp32", "tensor_parallel": {"tp_size": 2}},
+        params=jax.device_get(engine.params), mesh=engine.topology)
+    out_ref = np.asarray(oracle.generate(prompt, max_new_tokens=6, seed=1))
+    np.testing.assert_array_equal(out1, out_ref)
+
+
+def test_max_out_tokens_enforced():
+    engine = _make()
+    with pytest.raises(ValueError, match="max_out_tokens"):
+        engine.generate(np.zeros((1, 60), np.int32), max_new_tokens=16)

@@ -149,3 +149,76 @@ class TestEngineV2:
                                                                         num_kv_blocks=32), dtype="float32"))
         out = eng.generate([[5, 9, 2]], max_new_tokens=5)[0]
         assert out == _dense_generate(model, params, [5, 9, 2], 5)
+
+
+# ------------------------------------------------------------------ MoE + TP serving
+def _moe_model():
+    # GQA + MoE; generous capacity so the training-path oracle drops nothing
+    cfg = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2, d_model=32, max_seq_len=128,
+                            norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False,
+                            moe_num_experts=4, moe_top_k=2, moe_layer_freq=2, moe_capacity_factor=8.0,
+                            moe_min_capacity=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(3), {"input_ids": np.zeros((1, 8), np.int32)})
+    return model, params
+
+
+class TestEngineV2MoE:
+
+    def test_moe_generate_matches_dense(self):
+        """Ragged MoE serving (ref v2 ragged_ops moe_scatter/top_k_gating)
+        matches the dense training-path forward."""
+        model, params = _moe_model()
+        eng = InferenceEngineV2(
+            model, params,
+            RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=128,
+                                                                        num_kv_blocks=64), dtype="float32"))
+        prompts = [[3, 17, 42, 9], [7, 7, 7]]
+        outs = eng.generate(prompts, max_new_tokens=6)
+        for p, o in zip(prompts, outs):
+            assert o == _dense_generate(model, params, p, 6), f"MoE mismatch for prompt {p}"
+
+
+class TestEngineV2TP:
+
+    def test_tp2_generate_matches_tp1(self):
+        """TP-sharded v2 serving (ref v2/model_implementations/sharding/)
+        must reproduce the single-shard results."""
+        from deepspeed_tpu.parallel.mesh import initialize_mesh, reset_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        model, params = _tiny_model()
+        sm = RaggedBatchConfig(kv_block_size=8, max_context=128, num_kv_blocks=64)
+        eng1 = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(state_manager=sm, dtype="float32"))
+        prompts = [[3, 17, 42, 9], [100, 2], [55, 44, 33, 22, 11]]
+        out1 = eng1.generate(prompts, max_new_tokens=6)
+
+        reset_mesh()
+        topo = initialize_mesh(MeshConfig.from_dict({"data": 4, "tensor": 2}), force=True)
+        eng2 = InferenceEngineV2(model, params,
+                                 RaggedInferenceEngineConfig(state_manager=sm, dtype="float32",
+                                                             tensor_parallel=2), mesh=topo)
+        # params actually sharded over the tensor axis
+        qk = eng2.params["layer_0"]["attn"]["q_proj"]["kernel"]
+        assert "tensor" in str(qk.sharding.spec)
+        out2 = eng2.generate(prompts, max_new_tokens=6)
+        assert out1 == out2
+
+    def test_tp_moe_generate(self):
+        """GQA + MoE over a tensor=2 mesh matches the dense oracle
+        (VERDICT item: v2 runner was single-chip and raised on MoE)."""
+        from deepspeed_tpu.parallel.mesh import initialize_mesh, reset_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        model, params = _moe_model()
+        reset_mesh()
+        topo = initialize_mesh(MeshConfig.from_dict({"data": 4, "tensor": 2}), force=True)
+        eng = InferenceEngineV2(
+            model, params,
+            RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=128,
+                                                                        num_kv_blocks=64),
+                                        dtype="float32", tensor_parallel=2), mesh=topo)
+        prompts = [[3, 17, 42, 9], [7, 7, 7]]
+        outs = eng.generate(prompts, max_new_tokens=5)
+        for p, o in zip(prompts, outs):
+            assert o == _dense_generate(model, params, p, 5), f"TP-MoE mismatch for prompt {p}"
