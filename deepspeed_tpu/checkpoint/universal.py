@@ -122,12 +122,14 @@ def _write_universal(out_dir: str, tag: str, params_flat: Dict[str, np.ndarray],
         shutil.rmtree(final_root)
     os.replace(root, final_root)
     root = final_root
+    with open(os.path.join(out_dir, LATEST_FILENAME), "w") as f:
+        f.write(tag)
     if multi:
+        # barrier AFTER the LATEST write: when any rank returns, every
+        # rank (and external watchers) sees the completed checkpoint
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices(f"universal_save:{tag}")
-    with open(os.path.join(out_dir, LATEST_FILENAME), "w") as f:
-        f.write(tag)
     return root
 
 

@@ -1,7 +1,8 @@
+from .elastic_agent import DSElasticAgent, ElasticAgentConfig
 from .elasticity import (compute_elastic_config, elasticity_enabled, ensure_immutable_elastic_config,
                          ElasticityError, ElasticityConfigError, ElasticityIncompatibleWorldSize)
 
 __all__ = [
     "compute_elastic_config", "elasticity_enabled", "ensure_immutable_elastic_config", "ElasticityError",
-    "ElasticityConfigError", "ElasticityIncompatibleWorldSize"
+    "ElasticityConfigError", "ElasticityIncompatibleWorldSize", "DSElasticAgent", "ElasticAgentConfig"
 ]

@@ -1,0 +1,150 @@
+"""Elastic agent: in-run worker supervision + restart.
+
+Parity: reference ``elasticity/elastic_agent.py`` (``DSElasticAgent``
+:32, env injection :63, restart-on-membership-change via torch-elastic
+:125). The TPU-native shape: there is no per-GPU process group to
+re-rendezvous — recovery is *supervise, restart, resume from the latest
+checkpoint* (universal checkpoints make the resume world-size-agnostic,
+SURVEY §5 failure-detection plan). The agent:
+
+- launches the training command as a child process with DS env injected;
+- watches it; on failure (nonzero exit / missed heartbeat) kills any
+  stragglers and relaunches, up to ``max_restarts``;
+- re-resolves the device world each round (a TPU slice repair can change
+  it) and revalidates against the elastic batch config so the global
+  batch stays consistent (``compute_elastic_config``).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+from .elasticity import compute_elastic_config
+
+
+@dataclass
+class ElasticAgentConfig:
+    max_restarts: int = 3
+    restart_backoff_s: float = 5.0
+    heartbeat_file: Optional[str] = None  # worker touches it; stale => hung
+    heartbeat_timeout_s: float = 0.0  # 0 disables hang detection
+    poll_interval_s: float = 1.0
+
+
+class DSElasticAgent:
+    """Reference ``DSElasticAgent``: supervise workers, restart on failure."""
+
+    def __init__(self, cmd: Sequence[str], config: Optional[ElasticAgentConfig] = None,
+                 elastic_config: Optional[Dict] = None, env: Optional[Dict[str, str]] = None,
+                 world_size_fn: Optional[Callable[[], int]] = None):
+        self.cmd = list(cmd)
+        self.config = config or ElasticAgentConfig()
+        self.elastic_config = elastic_config
+        self.env = dict(env) if env is not None else dict(os.environ)
+        self._world_size_fn = world_size_fn
+        self.restarts = 0
+        self._proc: Optional[subprocess.Popen] = None
+
+    # -------------------------------------------------------------- env
+    def _ds_env(self, restart_round: int) -> Dict[str, str]:
+        """Reference :63 injects DS_* envs into worker env."""
+        env = dict(self.env)
+        env["DS_TPU_ELASTIC_RESTART"] = str(restart_round)
+        env["DS_TPU_ELASTIC_MAX_RESTARTS"] = str(self.config.max_restarts)
+        return env
+
+    def _validate_world(self) -> Optional[int]:
+        if self._world_size_fn is None:
+            return None
+        world = int(self._world_size_fn())
+        if self.elastic_config is not None:
+            # raises when the surviving world cannot keep the global batch
+            batch, _, micro = compute_elastic_config(self.elastic_config, world_size=world,
+                                                     return_microbatch=True)
+            logger.info(f"elastic agent: world={world} -> global_batch={batch} micro={micro}")
+        return world
+
+    # -------------------------------------------------------------- run
+    def _heartbeat_fresh(self) -> bool:
+        hb = self.config.heartbeat_file
+        if not hb or self.config.heartbeat_timeout_s <= 0 or not os.path.exists(hb):
+            return True
+        return (time.time() - os.path.getmtime(hb)) < self.config.heartbeat_timeout_s
+
+    def _terminate(self):
+        if self._proc is not None and self._proc.poll() is None:
+            self._proc.send_signal(signal.SIGTERM)
+            try:
+                self._proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+                self._proc.wait()
+
+    def run(self) -> int:
+        """Supervise until success or restarts are exhausted; returns the
+        final exit code."""
+        while True:
+            self._validate_world()
+            round_env = self._ds_env(self.restarts)
+            hb = self.config.heartbeat_file
+            if hb and os.path.exists(hb):
+                # a stale heartbeat from the previous round would kill the
+                # fresh worker before its first beat
+                os.unlink(hb)
+            logger.info(f"elastic agent: launching (round {self.restarts}): {' '.join(self.cmd)}")
+            self._proc = subprocess.Popen(self.cmd, env=round_env)
+            rc = self._watch()
+            if rc == 0:
+                logger.info("elastic agent: worker finished cleanly")
+                return 0
+            if self.restarts >= self.config.max_restarts:
+                logger.error(f"elastic agent: worker failed (rc={rc}) and restart budget exhausted "
+                             f"({self.restarts}/{self.config.max_restarts})")
+                return rc
+            self.restarts += 1
+            logger.warning(f"elastic agent: worker failed (rc={rc}); restart "
+                           f"{self.restarts}/{self.config.max_restarts} in {self.config.restart_backoff_s}s "
+                           "(training resumes from the latest checkpoint)")
+            time.sleep(self.config.restart_backoff_s)
+
+    def _watch(self) -> int:
+        assert self._proc is not None
+        while True:
+            rc = self._proc.poll()
+            if rc is not None:
+                return rc
+            if not self._heartbeat_fresh():
+                logger.warning("elastic agent: heartbeat stale — treating worker as hung")
+                self._terminate()
+                return -1
+            time.sleep(self.config.poll_interval_s)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    """``python -m deepspeed_tpu.elasticity.elastic_agent -- cmd args...``"""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="supervise + restart a training command")
+    parser.add_argument("--max_restarts", type=int, default=3)
+    parser.add_argument("--backoff", type=float, default=5.0)
+    parser.add_argument("--heartbeat_file", type=str, default=None)
+    parser.add_argument("--heartbeat_timeout", type=float, default=0.0)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("no command given")
+    agent = DSElasticAgent(cmd, ElasticAgentConfig(max_restarts=args.max_restarts,
+                                                   restart_backoff_s=args.backoff,
+                                                   heartbeat_file=args.heartbeat_file,
+                                                   heartbeat_timeout_s=args.heartbeat_timeout))
+    return agent.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -124,7 +124,26 @@ def fuse_lora_tree(params):
     return walk(params)
 
 
-def unfuse_lora_tree(params, fused_params):
-    """Inverse of :func:`fuse_lora_tree` when the caller only kept the
-    fused copy: restore ``kernel`` and adapters from the original."""
-    return params
+def unfuse_lora_tree(fused_params, adapter_source):
+    """Inverse of :func:`fuse_lora_tree` (reference ``hybrid_engine.py:148
+    unfuse_lora_weight``): subtract ``scale * A @ B`` back out of each
+    fused kernel and restore the adapters. ``adapter_source`` supplies the
+    live A/B/scale (the fused copy zeroes B, so they cannot come from the
+    fused tree itself)."""
+
+    def walk(fused, src):
+        if _is_lora_leafdict(src):
+            out = dict(fused)
+            scale = src.get(LORA_SCALE, jnp.asarray(1.0, jnp.float32))
+            a, b = src[LORA_A], src[LORA_B]
+            w = fused["kernel"]
+            out["kernel"] = (w.astype(jnp.float32) - scale.astype(jnp.float32) *
+                             (a.astype(jnp.float32) @ b.astype(jnp.float32))).astype(w.dtype)
+            out[LORA_A] = a
+            out[LORA_B] = b
+            return out
+        if isinstance(src, dict):
+            return {k: walk(fused[k], v) for k, v in src.items()}
+        return fused
+
+    return walk(fused_params, adapter_source)

@@ -2,13 +2,15 @@
 higher priority than the XLA fallbacks; selection is per-op via
 availability probing (real TPU backend) or DS_TPU_OP_* env overrides."""
 
-from . import flash_attention, fused_adam, norms, quantization  # noqa: F401
+from . import flash_attention, fused_adam, fused_lamb, norms, quantization  # noqa: F401
 
 from .flash_attention import flash_attention as flash_attention_fn
 from .fused_adam import fused_adam_flat
+from .fused_lamb import fused_lamb_flat
 from .norms import layer_norm, rms_norm
 from .paged_attention import paged_attention_decode, paged_attention_ref, update_kv_pages
-from .quantization import cast_fp8, dequantize_groupwise, quantize_groupwise
+from .quantization import (cast_fp8, dequantize_fp, dequantize_groupwise, quantize_fp, quantize_groupwise)
 
-__all__ = ["flash_attention_fn", "fused_adam_flat", "rms_norm", "layer_norm", "quantize_groupwise",
-           "dequantize_groupwise", "cast_fp8", "paged_attention_decode", "paged_attention_ref", "update_kv_pages"]
+__all__ = ["flash_attention_fn", "fused_adam_flat", "fused_lamb_flat", "rms_norm", "layer_norm",
+           "quantize_groupwise", "dequantize_groupwise", "cast_fp8", "quantize_fp", "dequantize_fp",
+           "paged_attention_decode", "paged_attention_ref", "update_kv_pages"]

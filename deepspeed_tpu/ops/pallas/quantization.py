@@ -98,3 +98,67 @@ REGISTRY.register("quantize", "pallas", quantize_groupwise, is_available=pallas_
 REGISTRY.register("quantize", "xla", quantize_groupwise_xla, priority=0)
 REGISTRY.register("dequantize", "pallas", dequantize_groupwise, is_available=pallas_available, priority=10)
 REGISTRY.register("dequantize", "xla", dequantize_groupwise_xla, priority=0)
+
+
+# ----------------------------------------------------------------------
+# minifloat (fp6/fp8/fp12) group quantization — reference
+# csrc/fp_quantizer/quantize.cu:530 (selective_dequantize / q_bits 6/8/12)
+# ----------------------------------------------------------------------
+FP_FORMATS = {
+    # q_bits: (exp_bits, man_bits) — the reference's fp_quantizer formats
+    6: (3, 2),
+    8: (4, 3),
+    12: (4, 7),
+}
+
+
+def _round_to_minifloat(x: jnp.ndarray, exp_bits: int, man_bits: int) -> jnp.ndarray:
+    """Round fp32 values to the nearest representable minifloat value
+    (sign + exp_bits + man_bits), saturating at the format max. Pure
+    bit manipulation -> XLA fuses it; the value grid is exactly what the
+    reference's packed codes decode to."""
+    x = x.astype(jnp.float32)
+    xi = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    drop = 23 - man_bits
+    # round-to-nearest-even on the kept mantissa bits
+    half = jnp.uint32((1 << (drop - 1)) - 1)
+    lsb = (xi >> drop) & jnp.uint32(1)
+    xi = xi + half + lsb
+    xi = xi & jnp.uint32(~((1 << drop) - 1) & 0xFFFFFFFF)
+    y = jax.lax.bitcast_convert_type(xi, jnp.float32)
+    # clamp to the format's dynamic range (bias = 2^(e-1) - 1)
+    bias = 2 ** (exp_bits - 1) - 1
+    max_exp = 2 ** exp_bits - 1 - bias  # no inf/nan encodings: top exp is a value
+    max_val = (2.0 - 2.0 ** (-man_bits)) * 2.0 ** max_exp
+    min_normal = 2.0 ** (1 - bias)
+    ay = jnp.abs(y)
+    y = jnp.sign(y) * jnp.clip(ay, 0.0, max_val)
+    # flush subnormals-of-the-format to zero (reference behavior)
+    y = jnp.where(jnp.abs(y) < min_normal, 0.0, y)
+    return y
+
+
+def quantize_fp(x: jnp.ndarray, q_bits: int = 8, group_size: int = 128):
+    """Group-wise minifloat quantization: scale each group so its absmax
+    hits the format max (maximizing used exponent range), then round to
+    the minifloat grid. Returns (values on the grid (rows, group), f32
+    scales (rows,)) — storage-ready: values/scale fit in q_bits + shared
+    scale, dequant = value * scale."""
+    if q_bits not in FP_FORMATS:
+        raise ValueError(f"q_bits {q_bits} unsupported: expected one of {sorted(FP_FORMATS)}")
+    e, m = FP_FORMATS[q_bits]
+    n = x.size
+    if n % group_size != 0:
+        raise ValueError(f"size {n} must be divisible by group_size {group_size}")
+    x2 = x.reshape(-1, group_size).astype(jnp.float32)
+    bias = 2 ** (e - 1) - 1
+    fmt_max = (2.0 - 2.0 ** (-m)) * 2.0 ** (2 ** e - 1 - bias)
+    absmax = jnp.max(jnp.abs(x2), axis=-1, keepdims=True)
+    scale = jnp.where(absmax == 0, 1.0, absmax / fmt_max)
+    q = _round_to_minifloat(x2 / scale, e, m)
+    return q, scale[:, 0]
+
+
+def dequantize_fp(q: jnp.ndarray, scales: jnp.ndarray, out_shape=None, out_dtype=jnp.float32):
+    out = (q.astype(jnp.float32) * scales[:, None]).astype(out_dtype)
+    return out.reshape(out_shape) if out_shape is not None else out

@@ -94,6 +94,18 @@ def _to_host(tree):
 class MsgpackCheckpointEngine(CheckpointEngine):
     def save(self, state: Dict[str, Any], path: str):
         self._write_host(_to_host(state), path)
+        self._barrier(path)
+
+    @staticmethod
+    def _barrier(path: str):
+        """Cross-process completion barrier: no rank treats the save as
+        durable before process 0's rename landed. MUST run on the main
+        thread (it is a device collective) — the async wrapper calls it
+        from wait(), never from the writer thread."""
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(f"msgpack_save:{os.path.basename(path)}")
 
     def _write_host(self, host_state, path: str):
         """Serialize + atomic write; only process 0 touches the file
@@ -191,11 +203,14 @@ class AsyncCheckpointEngine(CheckpointEngine):
         if isinstance(self.base, MsgpackCheckpointEngine):
             host_state = _to_host(state)  # snapshot NOW; params may move next step
             fut = self._executor.submit(self.base._write_host, host_state, path)
+            with self._lock:
+                self._pending.append(fut)
         else:
-            # orbax async is already backgrounded after its own snapshot
-            fut = self._executor.submit(self.base.save, state, path)
-        with self._lock:
-            self._pending.append(fut)
+            # other bases manage their own snapshot semantics (orbax's
+            # AsyncCheckpointer snapshots before returning; its sync
+            # checkpointer blocks) — calling them from the worker thread
+            # would let the next train step clobber un-snapshotted buffers
+            self.base.save(state, path)
 
     def wait(self):
         with self._lock:
@@ -207,6 +222,9 @@ class AsyncCheckpointEngine(CheckpointEngine):
             except Exception as e:  # drain EVERY write before surfacing
                 errors.append(e)
         self.base.wait()
+        if pending and isinstance(self.base, MsgpackCheckpointEngine):
+            # completion barrier on the MAIN thread (it is a collective)
+            self.base._barrier("async-drain")
         if errors:
             if len(errors) == 1:
                 raise errors[0]

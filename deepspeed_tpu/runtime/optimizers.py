@@ -68,6 +68,31 @@ def create_optimizer(name: Optional[str], params: Optional[Dict] = None) -> opta
         return optax.inject_hyperparams(lambda learning_rate: factory(learning_rate, **common))(
             learning_rate=a["learning_rate"])
 
+    if name == MUON:
+        from .muon import muon
+
+        # only the lr is a (traced) hyperparam: the rest drive Python-level
+        # branching inside the transform and must stay static
+        static = dict(momentum=params.get("momentum", 0.95), nesterov=params.get("nesterov", True),
+                      ns_steps=params.get("ns_steps", 5), adam_lr=params.get("adam_lr", 3e-4),
+                      weight_decay=params.get("weight_decay", 0.0))
+        return optax.inject_hyperparams(lambda learning_rate: muon(learning_rate, **static))(
+            learning_rate=params.get("lr", 0.02))
+
+    if name == FUSED_ADAM and params.get("adam_w_mode", True):
+        # explicit Pallas fused kernel when a TPU backend is live; the
+        # registry's XLA entry covers everything else (same math as the
+        # plain adam path below — fusion is the only difference). The
+        # kernel implements decoupled AdamW only: L2 mode falls through
+        # to the optax path so adam_w_mode=false keeps reference math.
+        from ..ops.registry import REGISTRY
+
+        if REGISTRY.selected("fused_adam") == "pallas":
+            a = _adam_args(params)
+            return optax.inject_hyperparams(
+                lambda learning_rate: _pallas_fused_adamw(learning_rate, a["b1"], a["b2"], a["eps"],
+                                                          a["weight_decay"]))(learning_rate=a["learning_rate"])
+
     if name in (ADAM_OPTIMIZER, FUSED_ADAM, CPU_ADAM):
         a = _adam_args(params)
         adam_mode = params.get("adam_w_mode", True)
@@ -103,6 +128,43 @@ def create_optimizer(name: Optional[str], params: Optional[Dict] = None) -> opta
         return optax.inject_hyperparams(optax.adagrad)(learning_rate=params.get("lr", 1e-2),
                                                        eps=params.get("eps", 1e-10))
     raise ValueError(f"Unknown optimizer type: {name}")
+
+
+def _pallas_fused_adamw(learning_rate, b1, b2, eps, weight_decay) -> optax.GradientTransformation:
+    """AdamW over the Pallas fused kernel (reference FusedAdam,
+    ``csrc/adam/multi_tensor_adam.cu``): one kernel pass per leaf updates
+    param/exp_avg/exp_avg_sq together. Returns updates = new_p - p so it
+    composes as a standard optax transform."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.registry import get_op
+
+    def init(params):
+        zeros = lambda p: jnp.zeros_like(p, dtype=jnp.float32)
+        return {"count": jnp.zeros((), jnp.int32),
+                "m": jax.tree_util.tree_map(zeros, params),
+                "v": jax.tree_util.tree_map(zeros, params)}
+
+    def update(grads, state, params=None):
+        assert params is not None, "fused adam needs params"
+        count = state["count"] + 1
+        kernel = get_op("fused_adam")
+
+        def leaf(p, g, m, v):
+            p32 = p.astype(jnp.float32)
+            new_p, new_m, new_v = kernel(p32, g.astype(jnp.float32), m, v, learning_rate, count,
+                                         b1=b1, b2=b2, eps=eps, weight_decay=weight_decay)
+            return (new_p - p32).astype(p.dtype), new_m, new_v
+
+        out = jax.tree_util.tree_map(leaf, params, grads, state["m"], state["v"])
+        is3 = lambda x: isinstance(x, tuple) and len(x) == 3
+        treedef = jax.tree_util.tree_structure(grads)
+        leaves = jax.tree_util.tree_leaves(out, is_leaf=is3)
+        pick = lambda i: jax.tree_util.tree_unflatten(treedef, [t[i] for t in leaves])
+        return pick(0), {"count": count, "m": pick(1), "v": pick(2)}
+
+    return optax.GradientTransformation(init, update)
 
 
 def set_learning_rate(opt_state, lr: float):

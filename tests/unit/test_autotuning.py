@@ -133,3 +133,6 @@ def test_failed_experiments_pruned():
     at2.run_experiment = lambda exp: None
     with pytest.raises(RuntimeError):
         at2.tune(stages=[0], micro_batches=[1])
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast

@@ -83,3 +83,6 @@ def test_unknown_keys_warn_not_raise():
 def test_mesh_config_defaults():
     m = MeshConfig.from_dict({})
     assert m.data == -1 and m.tensor == 1
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast

@@ -125,3 +125,52 @@ def test_immutability_check(monkeypatch):
     changed = dict(ecd, max_train_batch_size=4000)
     with pytest.raises(ElasticityConfigError):
         ensure_immutable_elastic_config(changed)
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast
+
+
+# ---------------- elastic agent (reference elastic_agent.py) ----------------
+def test_elastic_agent_restarts_until_success(tmp_path):
+    """A worker that fails twice then succeeds: the agent must restart it
+    (resume-from-checkpoint is the worker's job) and exit 0."""
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, ElasticAgentConfig
+
+    marker = tmp_path / "attempts"
+    script = tmp_path / "worker.py"
+    script.write_text(f"""
+import os, sys
+p = {str(marker)!r}
+n = int(open(p).read()) if os.path.exists(p) else 0
+open(p, "w").write(str(n + 1))
+sys.exit(0 if n >= 2 else 1)
+""")
+    import sys as _sys
+
+    agent = DSElasticAgent([_sys.executable, str(script)],
+                           ElasticAgentConfig(max_restarts=3, restart_backoff_s=0.01, poll_interval_s=0.05))
+    assert agent.run() == 0
+    assert agent.restarts == 2
+    assert marker.read_text() == "3"
+
+
+def test_elastic_agent_exhausts_budget(tmp_path):
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent, ElasticAgentConfig
+
+    script = tmp_path / "worker.py"
+    script.write_text("import sys; sys.exit(7)")
+    import sys as _sys
+
+    agent = DSElasticAgent([_sys.executable, str(script)],
+                           ElasticAgentConfig(max_restarts=1, restart_backoff_s=0.01, poll_interval_s=0.05))
+    assert agent.run() == 7
+    assert agent.restarts == 1
+
+
+def test_elastic_agent_validates_world():
+    from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+    ec = {"elasticity": {"enabled": True, "max_train_batch_size": 128, "micro_batch_sizes": [2, 4],
+                         "min_gpus": 1, "max_gpus": 64, "min_time": 0, "version": 0.1}}
+    agent = DSElasticAgent(["true"], elastic_config=ec, world_size_fn=lambda: 4)
+    assert agent._validate_world() == 4

@@ -138,3 +138,6 @@ def test_env_report_smoke():
     assert "deepspeed_tpu environment report" in text
     assert "jax" in text
     assert "op report" in text  # registry section present
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast

@@ -100,3 +100,21 @@ def test_moe_no_drop_capacity_overflow():
         # identity experts: combined output == per-token weight * token
         out = np.asarray(combine_output(dispatched, combine))
         np.testing.assert_allclose(out, w[:, None] * np.asarray(x), rtol=1e-4, atol=1e-5)
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast
+
+
+def test_unfuse_lora_tree_restores_base():
+    from deepspeed_tpu.linear import unfuse_lora_tree
+
+    mod = OptimizedLinear(output_dim=4, lora_config=LoRAConfig(lora_r=2, lora_alpha=4), dtype=jnp.float32)
+    params, x = _init(mod)
+    rng = np.random.RandomState(1)
+    params["lora_a"] = jnp.asarray(rng.randn(8, 2).astype(np.float32))
+    params["lora_b"] = jnp.asarray(rng.randn(2, 4).astype(np.float32))
+    fused = fuse_lora_tree({"proj": params})
+    restored = unfuse_lora_tree(fused, {"proj": params})["proj"]
+    np.testing.assert_allclose(np.asarray(restored["kernel"]), np.asarray(params["kernel"]), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_array_equal(np.asarray(restored["lora_b"]), np.asarray(params["lora_b"]))

@@ -54,3 +54,6 @@ def test_groups_getters(mesh8):
     assert groups.get_expert_parallel_world_size() == 1
     assert groups.get_sequence_parallel_world_size() == 1
     assert groups.get_data_parallel_rank() == 0
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast

@@ -231,3 +231,103 @@ def test_paged_attention_decode_matches_ref():
     out_ref = paged_attention_ref(q[:, None], k_pages, v_pages, bt_j, ctx_j, (ctx_j - 1)[:, None])[:, 0]
     out_pal = paged_attention_decode(q, k_pages, v_pages, bt_j, ctx_j, interpret=True)
     np.testing.assert_allclose(np.asarray(out_pal), np.asarray(out_ref), atol=2e-6, rtol=2e-6)
+
+
+# ---------------- fused LAMB ----------------
+def test_fused_lamb_matches_xla_reference():
+    from deepspeed_tpu.ops.pallas.fused_lamb import fused_lamb_flat, lamb_xla
+
+    rng = np.random.RandomState(0)
+    p = jnp.asarray(rng.randn(300).astype(np.float32))
+    g = jnp.asarray(rng.randn(300).astype(np.float32))
+    m = jnp.zeros(300, jnp.float32)
+    v = jnp.zeros(300, jnp.float32)
+    for step in (1, 2, 3):
+        p1, m1, v1 = fused_lamb_flat(p, g, m, v, 1e-2, step, weight_decay=0.01, block=128, interpret=True)
+        p2, m2, v2 = lamb_xla(p, g, m, v, 1e-2, step, weight_decay=0.01)
+        np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m1), np.asarray(m2), rtol=1e-6)
+        p, m, v = p1, m1, v1
+
+
+def test_fused_lamb_trust_ratio_bounds():
+    from deepspeed_tpu.ops.pallas.fused_lamb import lamb_xla
+
+    p = jnp.ones(64) * 1e6  # huge weights -> ratio clamps at max_trust
+    g = jnp.ones(64)
+    p1, _, _ = lamb_xla(p, g, jnp.zeros(64), jnp.zeros(64), 1.0, 1, max_trust=10.0)
+    assert float(jnp.max(jnp.abs(p - p1))) <= 10.0 + 1e-3
+
+
+# ---------------- fp6/fp8/fp12 minifloat quantizer ----------------
+def test_fp_quantizer_roundtrip_error_shrinks_with_bits():
+    from deepspeed_tpu.ops.pallas.quantization import dequantize_fp, quantize_fp
+
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(8, 128).astype(np.float32))
+    errs = {}
+    for qb in (6, 8, 12):
+        q, s = quantize_fp(x, q_bits=qb)
+        back = dequantize_fp(q, s, out_shape=x.shape)
+        errs[qb] = float(jnp.max(jnp.abs(back - x)))
+    assert errs[12] < errs[8] < errs[6]
+    assert errs[12] < 0.01
+
+
+def test_fp_quantizer_exact_on_grid():
+    from deepspeed_tpu.ops.pallas.quantization import dequantize_fp, quantize_fp
+
+    # powers of two are exactly representable in every format
+    x = jnp.asarray([[1.0, 0.5, 0.25, 2.0] * 32], jnp.float32)
+    q, s = quantize_fp(x, q_bits=6, group_size=128)
+    back = dequantize_fp(q, s, out_shape=x.shape)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x), rtol=1e-6)
+
+
+def test_fp_quantizer_rejects_bad_bits():
+    from deepspeed_tpu.ops.pallas.quantization import quantize_fp
+
+    with pytest.raises(ValueError):
+        quantize_fp(jnp.zeros(128), q_bits=7)
+
+
+# ---------------- muon ----------------
+def test_muon_orthogonalizes_and_converges():
+    from deepspeed_tpu.runtime.muon import muon, newton_schulz_orthogonalize
+
+    rng = np.random.RandomState(2)
+    g = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+    o = newton_schulz_orthogonalize(g)
+    # columns approximately orthonormal: o.T @ o ~ I
+    gram = np.asarray(o.T @ o)
+    np.testing.assert_allclose(gram, np.eye(8), atol=0.35)
+
+    # trains a quadratic (2D weight via muon, bias via adam)
+    import optax
+
+    A = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    w_true = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+    Y = A @ w_true  # realizable: loss can actually go to 0
+    params = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    opt = muon(learning_rate=0.05, adam_lr=0.05)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state):
+        loss, grads = jax.value_and_grad(lambda p: jnp.mean((A @ p["w"] + p["b"] - Y) ** 2))(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    l0 = None
+    for i in range(60):
+        params, state, loss = step(params, state)
+        l0 = l0 if l0 is not None else float(loss)
+    assert float(loss) < l0 * 0.5
+
+
+def test_muon_via_engine_config():
+    import deepspeed_tpu
+    from deepspeed_tpu.runtime.optimizers import create_optimizer
+
+    opt = create_optimizer("muon", {"lr": 0.02})
+    assert opt is not None

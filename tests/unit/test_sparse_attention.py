@@ -122,3 +122,6 @@ def test_module_wrapper():
     attn = SparseSelfAttention(FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2))
     out = attn(q, k, v)
     assert out.shape == q.shape and np.isfinite(np.asarray(out)).all()
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast

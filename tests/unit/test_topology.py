@@ -59,3 +59,6 @@ def test_invalid_dims():
         ProcessTopology(["a"], [0])
     with pytest.raises(ValueError):
         ProcessTopology(["a", "b"], [2])
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast

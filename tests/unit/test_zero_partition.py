@@ -107,3 +107,6 @@ def test_opt_state_specs_stage0_replicated():
     pspecs = plan_param_specs(shapes, _cfg(0), topo)
     ospecs, _ = plan_opt_state_specs(opt, shapes, pspecs, _cfg(0), topo)
     assert all(s == P() for s in jax.tree_util.tree_leaves(ospecs, is_leaf=lambda x: isinstance(x, P)))
+
+# quick tier: `pytest -m fast` smoke run
+pytestmark = pytest.mark.fast
