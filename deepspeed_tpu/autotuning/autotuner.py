@@ -100,6 +100,7 @@ class Autotuner:
         config = _deep_update(self.base_config, exp)
         config.pop("autotuning", None)
         engine = None
+        self._last_memory_bytes = None
         try:
             model = self.model_factory()
             params = self.params_factory() if self.params_factory else model.init(
@@ -130,6 +131,21 @@ class Autotuner:
 
             (jnp.zeros(()) + 0).block_until_ready()
             dt = time.perf_counter() - t0
+
+            # memory audit (reference gap: throughput-only tuning can pick
+            # a config one batch from OOM): compiled peak bytes per chip,
+            # recorded and optionally budget-gated
+            mem_bytes = self._measure_memory(engine, batch_at(0))
+            self._last_memory_bytes = mem_bytes
+            budget_gb = self.at_cfg.get("max_memory_per_chip_gb")
+            if budget_gb and mem_bytes is None:
+                logger.warning(f"autotuning experiment {exp}: memory budget set but peak memory is "
+                               "unmeasurable for this config (custom fwd_bwd path) — budget NOT enforced")
+            if mem_bytes is not None and budget_gb and mem_bytes > float(budget_gb) * (1 << 30):
+                logger.warning(f"autotuning experiment {exp} over memory budget: "
+                               f"{mem_bytes / (1 << 30):.2f} GiB > {budget_gb} GiB")
+                return None
+
             samples = self.steps_per_trial * mb * dp * engine.gradient_accumulation_steps
             if self.metric == "latency":
                 return -dt / self.steps_per_trial
@@ -140,6 +156,36 @@ class Autotuner:
         finally:
             del engine
             gc.collect()
+
+    def _measure_memory(self, engine, batch) -> Optional[int]:
+        """Peak per-chip memory of the trial. Prefers the backend's live
+        allocator stats (true runtime peak, zero extra compilation);
+        falls back to XLA buffer-assignment totals of the train step
+        (pays one re-lower, but lower()/compile() hit the jit cache's
+        already-built executable on most backends)."""
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+            if stats and stats.get("peak_bytes_in_use"):
+                return int(stats["peak_bytes_in_use"])
+        except Exception:
+            pass
+        try:
+            fwd_bwd = engine._fwd_bwd
+            if not hasattr(fwd_bwd, "lower"):
+                return None
+            compiled = fwd_bwd.lower(engine.params, engine._put_batch(batch), 0, 1.0).compile()
+            mem = compiled.memory_analysis()
+            if mem is None:
+                return None
+            total = 0
+            for attr in ("argument_size_in_bytes", "output_size_in_bytes", "temp_size_in_bytes",
+                         "generated_code_size_in_bytes"):
+                total += int(getattr(mem, attr, 0) or 0)
+            return total or None
+        except Exception:
+            return None
 
     def tune(self, stages: Optional[List[int]] = None, micro_batches: Optional[List[int]] = None) -> Dict:
         """Run the search; returns the best merged config (reference :404)."""
@@ -156,7 +202,8 @@ class Autotuner:
             exp = batch[0]
             val = self.run_experiment(exp)
             tuner.record(exp, val)
-            self.records.append({"exp": exp, self.metric: val})
+            self.records.append({"exp": exp, self.metric: val,
+                                 "memory_bytes": getattr(self, "_last_memory_bytes", None)})
             logger.info(f"autotuning [{n_run + 1}/{min(max_trials, len(exps))}] {exp} -> {val}")
             n_run += 1
             if tuner.should_stop(early_stop):

@@ -203,10 +203,23 @@ def broadcast(tensor, src: int = 0, group=None, async_op: bool = False):
 
 
 def all_gather_object(obj, group=None):
+    """Gather arbitrary python objects from every process (reference
+    ``torch.distributed.all_gather_object``): pickle -> padded uint8
+    buffer -> cross-process allgather -> unpickle per rank."""
     if jax.process_count() > 1:
+        import pickle
+
+        import numpy as np
         from jax.experimental import multihost_utils
 
-        return multihost_utils.process_allgather(obj)
+        blob = np.frombuffer(pickle.dumps(obj), np.uint8)
+        sizes = multihost_utils.process_allgather(np.asarray([blob.size], np.int64))  # (P, 1)
+        sizes = np.asarray(sizes).reshape(-1)
+        maxlen = int(sizes.max())
+        padded = np.zeros((maxlen,), np.uint8)
+        padded[:blob.size] = blob
+        datas = np.asarray(multihost_utils.process_allgather(padded))  # (P, maxlen)
+        return [pickle.loads(datas[i, :sizes[i]].tobytes()) for i in range(len(sizes))]
     return [obj]
 
 

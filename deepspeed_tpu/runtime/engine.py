@@ -231,6 +231,13 @@ class DeepSpeedEngine:
             self.compression_engine = CompressionEngine(self.params, self.config.compression_config,
                                                         num_heads=getattr(model_cfg, "n_heads", None))
 
+        # reference wires checkpointing.configure from the engine too;
+        # unconditional so a previous engine's flags never leak into this
+        # one through the module-level config
+        from .activation_checkpointing import configure as _ac_configure
+
+        _ac_configure(deepspeed_config=self.config)
+
         self._build_compiled_fns()
         log_dist(
             f"DeepSpeedEngine: stage={self.zero_optimization_stage()} dtype={self.compute_dtype.__name__} "

@@ -146,3 +146,22 @@ print("ULOAD_OK")
 """], env=env, capture_output=True, text=True, timeout=420)
     assert r.returncode == 0, r.stderr[-3000:]
     assert "ULOAD_OK" in r.stdout
+
+
+def test_comm_facade_multiprocess():
+    """The deepspeed_tpu.comm façade's multi-host paths (init_distributed,
+    rank/world accessors, barrier, all_gather_object) over two REAL
+    processes — previously only exercised single-process."""
+    out = run_distributed("""
+import deepspeed_tpu.comm as dist
+
+dist.init_distributed(verbose=False)
+assert dist.is_initialized()
+assert dist.get_world_size() == 4          # 2 procs x 2 devices
+assert dist.get_local_rank() == 0
+objs = dist.all_gather_object({"rank": RANK, "payload": [RANK] * 3})
+assert len(objs) == 2 and objs[0]["rank"] == 0 and objs[1]["rank"] == 1, objs
+dist.barrier()
+print("COMM_OK", RANK)
+""")
+    assert all("COMM_OK" in o for o in out)

@@ -113,3 +113,14 @@ def test_sharded_index_roundtrip(tiny_llama_ckpt, tmp_path):
     assert set(again) == set(full)
     for k in full:
         np.testing.assert_array_equal(full[k], again[k])
+
+
+def test_init_inference_from_hf_path(tiny_llama_ckpt):
+    """init_inference(model=<hf dir>) loads + serves directly (reference
+    inference/engine.py:331 checkpoint-loading path)."""
+    import deepspeed_tpu
+
+    d, ids, ref_logits = tiny_llama_ckpt
+    engine = deepspeed_tpu.init_inference(d, config={"dtype": "fp32"})
+    logits = np.asarray(engine.forward(ids))
+    np.testing.assert_allclose(logits, ref_logits, rtol=2e-4, atol=2e-4)
