@@ -2,10 +2,21 @@
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
 
-Measures bf16 training throughput (tokens/sec/chip) of a GPT-2-125M-class
-model under the engine's ZeRO-2 path on whatever devices are available
-(config ladder step 2 of BASELINE.md; the 7B/v5e-256 north-star needs a pod).
-Sweeps the per-chip micro-batch size and reports the best.
+Default rung: bf16 training throughput (tokens/sec/chip) of a
+GPT-2-125M-class model under the engine's ZeRO-2 path (config ladder
+step 2 of BASELINE.md; the 7B/v5e-256 north-star needs a pod). Sweeps
+the per-chip micro-batch size and reports the best.
+
+``DS_BENCH_RUNG`` selects other ladder rungs (VERDICT: bench covered one
+rung only):
+- ``zero2`` (default) — ladder step 2.
+- ``zero3`` — same model under ZeRO-3 (stage-3 machinery on the fwd/bwd
+  path; same 350k/chip target: stage 3 on one chip must not regress).
+- ``decode`` — ladder step 5 analogue on one chip: greedy decode
+  throughput (new tokens/s) of the v1 inference engine at batch 32.
+  Target 25k tok/s/chip: decode is HBM-bound — 125M bf16 params =
+  0.25 GB/step at v5e's ~820 GB/s gives ~3.2k steps/s upper bound x 32
+  sequences x ~25% achievable.
 
 vs_baseline: ratio against a DeepSpeed reference point for the same model
 class: GPT-2-125M-scale training on one A100 runs at roughly 550k tokens/s
@@ -22,17 +33,18 @@ otherwise dominate the measurement.
 """
 
 import json
+import os
 import sys
 import time
 
 
-def run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters):
+def run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters, stage=2):
     config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
         "bf16": {"enabled": True},
         "optimizer": {"type": "adam", "params": {"lr": 1e-4}},
-        "zero_optimization": {"stage": 2},
+        "zero_optimization": {"stage": stage},
         "steps_per_print": 10**9,
     }
     model = deepspeed_tpu.models.CausalLM(cfg_model)
@@ -62,6 +74,35 @@ def run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters):
     return global_bs * seq * iters / dt, float(loss)
 
 
+def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
+    """Greedy decode throughput (new tokens/s), prefill excluded.
+
+    Differential timing: generate N and N/2 new tokens on the same
+    prompts; the time delta is pure decode steps, so the fixed prefill
+    (and the compile/dispatch constants) cancels out of the rate.
+    """
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM(cfg_model)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, prompt_len), np.int32)})
+    eng = deepspeed_tpu.init_inference(model, config={"dtype": "bf16", "max_out_tokens": prompt_len + new_tokens},
+                                       params=params)
+    rng = np.random.RandomState(0)
+    prompts = rng.randint(0, cfg_model.vocab_size, size=(batch, prompt_len)).astype(np.int32)
+    half = max(1, new_tokens // 2)
+    jax.block_until_ready(eng.generate(prompts, max_new_tokens=new_tokens))  # compile both paths
+    jax.block_until_ready(eng.generate(prompts, max_new_tokens=half))
+
+    t0 = time.perf_counter()
+    jax.block_until_ready(eng.generate(prompts, max_new_tokens=half))
+    t1 = time.perf_counter()
+    jax.block_until_ready(eng.generate(prompts, max_new_tokens=new_tokens))
+    t2 = time.perf_counter()
+    decode_dt = max((t2 - t1) - (t1 - t0), 1e-9)  # time for the extra (N - N/2) steps
+    return batch * (new_tokens - half) / decode_dt
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -72,25 +113,50 @@ def main():
     from deepspeed_tpu.models import TransformerConfig
     from deepspeed_tpu.ops.registry import REGISTRY
 
+    rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
+    if rung not in ("zero2", "zero3", "decode"):
+        print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected zero2 | zero3 | decode", file=sys.stderr)
+        return 1
     n_dev = jax.device_count()
     platform = jax.devices()[0].platform
-    print(f"[bench] platform={platform} devices={n_dev} "
+    print(f"[bench] platform={platform} devices={n_dev} rung={rung} "
           f"attention={REGISTRY.selected('attention')}", file=sys.stderr)
 
     seq = 1024
     if platform != "tpu":
         cfg_model = TransformerConfig(vocab_size=1024, n_layers=2, n_heads=4, d_model=128, max_seq_len=seq,
                                       dtype=jnp.bfloat16)
-        sweep, iters = [1], 3
+        sweep, iters, decode_bs, decode_new = [1], 3, 2, 8
+        tag = "(cpu-smoke)"
     else:
         cfg_model = TransformerConfig(vocab_size=50257, n_layers=12, n_heads=12, d_model=768, max_seq_len=seq,
                                       dtype=jnp.bfloat16)
-        sweep, iters = [8, 16, 32], 20
+        sweep, iters, decode_bs, decode_new = [8, 16, 32], 20, 32, 64
+        tag = ""
 
+    if rung == "decode":
+        try:
+            tps = run_decode(jax, jnp, np, cfg_model, decode_bs, prompt_len=128, new_tokens=decode_new)
+        except Exception as e:
+            print(f"[bench] decode rung failed: {type(e).__name__}: {e}", file=sys.stderr)
+            return 1
+        # decode runs replicated (tp=1, batch unsharded): the measured rate
+        # IS the per-chip rate — dividing by n_dev would undercount
+        per_chip = tps
+        baseline = 25_000.0  # see module docstring
+        print(json.dumps({
+            "metric": f"gpt2-125m_bf16_greedy_decode_tokens_per_sec_per_chip{tag}",
+            "value": round(per_chip, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(per_chip / baseline, 4),
+        }))
+        return 0
+
+    stage = 3 if rung == "zero3" else 2
     best = (0.0, None, None)
     for micro_bs in sweep:
         try:
-            tps, loss = run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters)
+            tps, loss = run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters, stage=stage)
         except Exception as e:  # OOM at large batch: record and move on
             print(f"[bench] micro_bs={micro_bs} failed: {type(e).__name__}: {e}", file=sys.stderr)
             continue
@@ -105,8 +171,8 @@ def main():
     tokens_per_sec_chip = best[0] / n_dev
     baseline_tokens_per_sec_chip = 350_000.0  # see module docstring
     print(json.dumps({
-        "metric": "gpt2-125m_zero2_bf16_train_tokens_per_sec_per_chip" if platform == "tpu"
-        else "tiny_zero2_bf16_train_tokens_per_sec_per_chip(cpu-smoke)",
+        "metric": f"gpt2-125m_zero{stage}_bf16_train_tokens_per_sec_per_chip{tag}" if platform == "tpu"
+        else f"tiny_zero{stage}_bf16_train_tokens_per_sec_per_chip{tag}",
         "value": round(tokens_per_sec_chip, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(tokens_per_sec_chip / baseline_tokens_per_sec_chip, 4),

@@ -74,7 +74,8 @@ class InferenceEngine:
                                eos_token_id=eos_token_id, seed=seed)
 
     def forward(self, input_ids, **kwargs):
-        return self.module.apply(self.params, jnp.asarray(input_ids, jnp.int32))
+        # train=False: MoE serving must never capacity-drop tokens
+        return self.module.apply(self.params, jnp.asarray(input_ids, jnp.int32), train=False)
 
     __call__ = forward
 

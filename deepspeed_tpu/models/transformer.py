@@ -173,6 +173,7 @@ class MLP(nn.Module):
 class Block(nn.Module):
     cfg: TransformerConfig
     layer_idx: int = 0
+    is_training: bool = True  # static: MoE capacity-drop is train-only
 
     @property
     def is_moe(self) -> bool:
@@ -195,7 +196,8 @@ class Block(nn.Module):
 
             mlp_out = MoE(hidden_size=cfg.d_model, num_experts=cfg.moe_num_experts, k=cfg.moe_top_k,
                           capacity_factor=cfg.moe_capacity_factor, min_capacity=cfg.moe_min_capacity,
-                          d_ff=cfg.ffn_dim, activation=cfg.activation, dtype=cfg.dtype, name="moe")(h)
+                          d_ff=cfg.ffn_dim, activation=cfg.activation, dtype=cfg.dtype,
+                          name="moe")(h, train=self.is_training)
         else:
             mlp_out = MLP(cfg, name="mlp")(h)
         x = x + mlp_out
@@ -208,8 +210,12 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None, return_hidden=False):
+    def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None, return_hidden=False,
+                 train=None):
         cfg = self.cfg
+        # decode (kv caches) implies inference; forward-only callers pass
+        # train=False so eval/serving never drops MoE tokens
+        train = (kv_caches is None) if train is None else bool(train)
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -224,10 +230,10 @@ class Transformer(nn.Module):
         if cfg.remat and kv_caches is None:
             block_cls = nn.remat(Block, static_argnums=())
         if cfg.scan_layers and kv_caches is None:
-            x = self._scan_blocks(block_cls, x, positions, segment_ids)
+            x = self._scan_blocks(block_cls, x, positions, segment_ids, train)
         else:
             for i in range(cfg.n_layers):
-                blk = block_cls(cfg, layer_idx=i, name=f"layer_{i}")
+                blk = block_cls(cfg, layer_idx=i, is_training=train, name=f"layer_{i}")
                 if kv_caches is not None:
                     x, c = blk(x, positions, kv_caches[i], segment_ids)
                     new_caches.append(c)
@@ -247,7 +253,7 @@ class Transformer(nn.Module):
         logits = logits.astype(jnp.float32)
         return (logits, new_caches) if kv_caches is not None else logits
 
-    def _scan_blocks(self, block_cls, x, positions, segment_ids):
+    def _scan_blocks(self, block_cls, x, positions, segment_ids, train=True):
         cfg = self.cfg
 
         class ScanBody(nn.Module):
@@ -255,7 +261,7 @@ class Transformer(nn.Module):
 
             @nn.compact
             def __call__(self, carry, _):
-                y = block_cls(self.cfg, name="block")(carry, positions, None, segment_ids)
+                y = block_cls(self.cfg, is_training=train, name="block")(carry, positions, None, segment_ids)
                 return y, None
 
         scanned = nn.scan(ScanBody, variable_axes={"params": 0}, split_rngs={"params": True}, length=cfg.n_layers,

@@ -107,7 +107,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             dtype=dtype,
         )
-    elif model_type in ("llama", "mistral", "qwen2", ""):
+    elif model_type in ("llama", "mistral", "qwen2", "mixtral", ""):
         kw = dict(
             vocab_size=hf["vocab_size"],
             n_layers=hf.get("num_hidden_layers", 2),
@@ -124,9 +124,16 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("rms_norm_eps", 1e-6),
             dtype=dtype,
         )
+        if model_type == "mixtral":
+            kw.update(
+                moe_num_experts=hf.get("num_local_experts", 8),
+                moe_top_k=hf.get("num_experts_per_tok", 2),
+                moe_layer_freq=1,  # every mixtral block is MoE
+                moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
+            )
     else:
         raise NotImplementedError(f"HF model_type '{model_type}' not supported "
-                                  "(supported: gpt2, llama, mistral, qwen2)")
+                                  "(supported: gpt2, llama, mistral, qwen2, mixtral)")
     kw.update(overrides)
     return TransformerConfig(**kw)
 
@@ -189,10 +196,13 @@ def convert_gpt2(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
 
 
 def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
-    """HF ``LlamaForCausalLM`` (or mistral/qwen2) state dict -> CausalLM pytree.
+    """HF ``LlamaForCausalLM`` (or mistral/qwen2/mixtral) state dict ->
+    CausalLM pytree.
 
     torch ``nn.Linear`` stores (out, in) — transposed into flax (in, out);
     attention projections reshape the fused head dim into (H, head_dim).
+    Mixtral MoE blocks map ``block_sparse_moe.gate`` -> gate kernel and
+    per-expert w1/w3/w2 -> stacked wg/wi/wo expert tensors.
     """
     has_lm_head = "lm_head.weight" in sd
     sd = _strip_prefix(sd)
@@ -217,12 +227,24 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
                 "v_proj": {"kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(dm, KVH, D)},
                 "o_proj": {"kernel": sd[p + "self_attn.o_proj.weight"].T.reshape(H, D, dm)},
             },
-            "mlp": {
+        }
+        if p + "block_sparse_moe.gate.weight" in sd:  # mixtral MoE block
+            E = cfg.moe_num_experts
+            layer["moe"] = {
+                "gate": {"kernel": sd[p + "block_sparse_moe.gate.weight"].T},
+                "experts": {
+                    # our Experts: h = silu(x@wg) * (x@wi); out = h@wo
+                    "wg": np.stack([sd[p + f"block_sparse_moe.experts.{j}.w1.weight"].T for j in range(E)]),
+                    "wi": np.stack([sd[p + f"block_sparse_moe.experts.{j}.w3.weight"].T for j in range(E)]),
+                    "wo": np.stack([sd[p + f"block_sparse_moe.experts.{j}.w2.weight"].T for j in range(E)]),
+                },
+            }
+        else:
+            layer["mlp"] = {
                 "gate_proj": {"kernel": sd[p + "mlp.gate_proj.weight"].T},
                 "up_proj": {"kernel": sd[p + "mlp.up_proj.weight"].T},
                 "down_proj": {"kernel": sd[p + "mlp.down_proj.weight"].T},
-            },
-        }
+            }
         # qwen2 carries attention biases
         for proj, heads in (("q_proj", H), ("k_proj", KVH), ("v_proj", KVH)):
             bkey = p + f"self_attn.{proj}.bias"

@@ -80,9 +80,13 @@ class MoE(nn.Module):
         gate_logits = nn.Dense(self.num_experts, use_bias=False, name="gate", dtype=jnp.float32,
                                param_dtype=jnp.float32)(tokens.astype(jnp.float32))
         cf = self.capacity_factor if train else self.eval_capacity_factor
+        # inference must never drop a token (capacity is a TRAINING
+        # regularizer; dropped tokens at eval silently corrupt logits —
+        # cf. the v2 ragged serving path and the HF-parity contract)
+        drop = self.drop_tokens and train
         l_aux, dispatched, combine, exp_counts = gate_and_dispatch(
             tokens, gate_logits, self.k, cf, self.min_capacity, rng=rng,
-            noisy_gate_policy=self.noisy_gate_policy if train else None, drop_tokens=self.drop_tokens)
+            noisy_gate_policy=self.noisy_gate_policy if train else None, drop_tokens=drop)
 
         # shard the expert dim -> XLA all-to-all over the expert mesh axis
         dispatched = jax.lax.with_sharding_constraint(dispatched, P("expert", None, None)) \
