@@ -211,11 +211,13 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None, return_hidden=False,
-                 train=None):
+                 train=None, pld_theta=None):
         cfg = self.cfg
         # decode (kv caches) implies inference; forward-only callers pass
         # train=False so eval/serving never drops MoE tokens
         train = (kv_caches is None) if train is None else bool(train)
+        if pld_theta is not None and cfg.scan_layers:
+            raise ValueError("progressive layer drop needs the unrolled layer loop: set scan_layers=False")
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -238,7 +240,14 @@ class Transformer(nn.Module):
                     x, c = blk(x, positions, kv_caches[i], segment_ids)
                     new_caches.append(c)
                 else:
-                    x = blk(x, positions, None, segment_ids)
+                    y = blk(x, positions, None, segment_ids)
+                    if pld_theta is not None and train:
+                        # progressive layer drop (arXiv:2010.13369): deeper
+                        # layers drop more; keep prob 1-(1-theta)*l/L
+                        pkeep = 1.0 - (1.0 - pld_theta) * (i + 1) / cfg.n_layers
+                        keep = jax.random.bernoulli(self.make_rng("pld"), pkeep)
+                        y = jnp.where(keep, y, x)
+                    x = y
 
         x = make_norm(cfg)(x)
         if return_hidden:
@@ -301,13 +310,19 @@ class CausalLM:
         from ..ops.fused_ce import fused_cross_entropy
 
         input_ids = batch["input_ids"]
+        pld_theta = batch.get("pld_theta")  # injected by the engine when PLD is on
+        extra = {}
+        if pld_theta is not None:
+            if rng is None:
+                raise ValueError("progressive layer drop needs the engine's step rng")
+            extra = {"pld_theta": pld_theta, "rngs": {"pld": rng}}
         if self.cfg.moe_num_experts > 0:
             hidden, mods = self.module.apply({"params": params}, input_ids, return_hidden=True,
-                                             mutable=["losses", "intermediates"])
+                                             mutable=["losses", "intermediates"], **extra)
             aux_leaves = jax.tree_util.tree_leaves(mods.get("losses", {}))
             aux = sum(jnp.sum(l) for l in aux_leaves) if aux_leaves else 0.0
         else:
-            hidden = self.apply(params, input_ids, return_hidden=True)
+            hidden = self.apply(params, input_ids, return_hidden=True, **extra)
             aux = 0.0
         if self.cfg.tie_embeddings:
             w, vd = params["wte"].astype(self.cfg.dtype), True

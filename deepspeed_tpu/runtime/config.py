@@ -363,6 +363,7 @@ class DeepSpeedConfig:
         # legacy curriculum section (reference constants.py CURRICULUM_LEARNING_LEGACY)
         self.curriculum_learning_legacy = d.get("curriculum_learning", {})
         self.random_ltd_config = d.get("random_ltd", {})
+        self.pld_config = d.get("progressive_layer_drop", {})
 
         self.gradient_clipping = float(d.get("gradient_clipping", 0.0))
         self.prescale_gradients = bool(d.get("prescale_gradients", False))

@@ -217,6 +217,17 @@ class DeepSpeedEngine:
 
             self.random_ltd_scheduler = RandomLTDScheduler(rltd)
 
+        # progressive layer drop (reference engine.py:1821 pld kwargs
+        # injection): engine owns the theta schedule; forward() threads the
+        # current theta into the batch as a traced scalar
+        self.progressive_layer_drop = None
+        pld_cfg = self.config.pld_config
+        if pld_cfg.get("enabled", False):
+            from .progressive_layer_drop import ProgressiveLayerDrop
+
+            self.progressive_layer_drop = ProgressiveLayerDrop(theta=pld_cfg.get("theta", 0.5),
+                                                               gamma=pld_cfg.get("gamma", 0.001))
+
         # --- training data ---
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data)
@@ -368,6 +379,11 @@ class DeepSpeedEngine:
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
+        if self.progressive_layer_drop is not None and isinstance(batch, dict):
+            # traced scalar, not a python float: theta changes every step
+            # and must not retrigger compilation
+            batch = dict(batch)
+            batch["pld_theta"] = np.asarray(self.progressive_layer_drop.get_theta(), np.float32)
         batch = self._put_batch(batch)
         scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
         profiling = (self.config.flops_profiler.enabled
@@ -443,6 +459,8 @@ class DeepSpeedEngine:
         self.global_steps += 1
         if self.random_ltd_scheduler is not None:
             self.random_ltd_scheduler.update_seq(self.global_steps)
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
         if self.compression_engine is not None:
             self.compression_engine.scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
@@ -663,6 +681,10 @@ class DeepSpeedEngine:
                 self.micro_steps = int(state["micro_steps"])
                 self.global_samples = int(state["global_samples"])
                 self.skipped_steps = int(state["skipped_steps"])
+                if self.progressive_layer_drop is not None:
+                    # theta is a pure function of the step — re-derive it or
+                    # the first resumed step trains with theta=1 (no drop)
+                    self.progressive_layer_drop.update_state(self.global_steps)
             curriculum_path = os.path.join(d, CURRICULUM_STATE_FILENAME)
             if self.curriculum_scheduler is not None and os.path.exists(curriculum_path):
                 self.curriculum_scheduler.set_state(self.checkpoint_engine.load(curriculum_path))

@@ -1,0 +1,44 @@
+"""Progressive Layer Dropping (PLD).
+
+Parity: reference ``runtime/progressive_layer_drop.py`` — the PLD
+schedule from https://arxiv.org/pdf/2010.13369.pdf: the keep probability
+``theta_t = (1 - theta) * exp(-gamma * t) + theta`` decays from 1 toward
+``theta`` over training; layer ``l`` of ``L`` keeps with probability
+``1 - (1 - theta_t) * l / L`` (deeper layers drop more).
+
+Model side: :class:`~deepspeed_tpu.models.Transformer` accepts
+``pld_theta`` — each block is kept with probability
+``1 - (1 - theta_t) * l / L`` via a per-layer Bernoulli from the ``pld``
+RNG stream and replaced by the identity otherwise, with NO 1/p
+rescaling — the paper's (and reference BERT example's) semantics: the
+network is trained to tolerate missing layers, and inference (no theta)
+runs the full stack.
+"""
+
+import math
+
+from ..utils.logging import log_dist
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = float(theta)
+        self.gamma = float(gamma)
+        self.current_theta = 1.0
+        log_dist(f"Enabled progressive layer dropping (theta = {self.theta})", ranks=[0])
+
+    def get_state(self):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta
+
+    def update_state(self, global_step: int) -> float:
+        self.current_theta = (1.0 - self.theta) * math.exp(-self.gamma * global_step) + self.theta
+        return self.current_theta
+
+    def state_dict(self):
+        return {"current_theta": self.current_theta}
+
+    def load_state_dict(self, sd):
+        self.current_theta = float(sd["current_theta"])

@@ -1,7 +1,9 @@
 from .logging import log_dist, logger, print_rank_0, warning_once
 from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer
 from . import groups
+from .debug import assert_all_finite, check_shard_consistency, enable_debug_nans
 from .memory import see_memory_usage
 
 __all__ = ["logger", "log_dist", "print_rank_0", "warning_once", "SynchronizedWallClockTimer", "ThroughputTimer",
-           "NoopTimer", "groups", "see_memory_usage"]
+           "NoopTimer", "groups", "see_memory_usage", "assert_all_finite", "check_shard_consistency",
+           "enable_debug_nans"]

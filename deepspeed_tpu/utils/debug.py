@@ -1,0 +1,105 @@
+"""Numerical + sharding safety nets.
+
+Parity: the reference ships overflow checks and ``safe_mode``
+re-verification (``stage_1_and_2.py:1995``, ``stage3.py:1249``) but no
+sanitizer framework; SURVEY §5 planned "jax debug_nans + our own
+shard-consistency asserts" for the TPU build. This module is those
+asserts:
+
+- :func:`assert_all_finite` — host-side NaN/Inf audit of any pytree with
+  per-leaf reporting (the debug-mode step check; jax's global
+  ``debug_nans`` flag catches the first NaN inside jit, this one tells
+  you WHICH state leaf went bad between steps).
+- :func:`check_shard_consistency` — verifies that the replicated copies
+  of an array (or every replicated leaf of a pytree) are bit-identical
+  across devices: the invariant SPMD training relies on and the
+  reference re-derives with ``safe_mode`` recomputation.
+- :func:`enable_debug_nans` — flips jax's trap-on-NaN mode.
+"""
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from .logging import logger
+
+
+def enable_debug_nans(enabled: bool = True):
+    """Trap the first NaN produced inside any jitted computation."""
+    jax.config.update("jax_debug_nans", enabled)
+
+
+def _named_leaves(tree):
+    flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+    for path, leaf in flat:
+        name = "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path) or "<root>"
+        yield name, leaf
+
+
+def assert_all_finite(tree, name: str = "tree", raise_error: bool = True) -> List[str]:
+    """Return (and optionally raise on) the names of non-finite leaves."""
+    import jax.numpy as jnp
+
+    bad = []
+    for leaf_name, leaf in _named_leaves(tree):
+        arr = np.asarray(jax.device_get(leaf)) if isinstance(leaf, jax.Array) else np.asarray(leaf)
+        # jnp.issubdtype: ml_dtypes (bfloat16/fp8 — the common TPU dtypes)
+        # are NOT np.floating subtypes and would silently skip the audit
+        if not jnp.issubdtype(arr.dtype, jnp.floating):
+            continue
+        arr32 = arr.astype(np.float32)
+        if not np.isfinite(arr32).all():
+            n_nan = int(np.isnan(arr32).sum())
+            n_inf = int(np.isinf(arr32).sum())
+            bad.append(f"{leaf_name} (nan={n_nan}, inf={n_inf}, shape={arr.shape})")
+    if bad and raise_error:
+        raise FloatingPointError(f"non-finite values in {name}: {bad[:8]}"
+                                 + (f" (+{len(bad) - 8} more)" if len(bad) > 8 else ""))
+    return bad
+
+
+def _replica_groups(arr: jax.Array) -> Dict[Tuple, List]:
+    """Group addressable shards by array-index window: shards covering the
+    same window are replicas and must agree."""
+    groups: Dict[Tuple, List] = {}
+    for shard in arr.addressable_shards:
+        key = tuple((s.start, s.stop) for s in shard.index) if shard.index else ()
+        groups.setdefault(key, []).append(shard)
+    return groups
+
+
+def check_shard_consistency(tree, name: str = "tree", atol: float = 0.0,
+                            raise_error: bool = True) -> List[str]:
+    """Verify replicated shards are identical across devices.
+
+    For every leaf, shards that cover the same index window of the global
+    array are replicas; any divergence means a collective went wrong or
+    host-side state skewed — the silent corruption class the reference's
+    ``safe_mode`` guards against. Returns the names of divergent leaves.
+    """
+    bad = []
+    for leaf_name, leaf in _named_leaves(tree):
+        if not isinstance(leaf, jax.Array) or not leaf.addressable_shards:
+            continue
+        for window, shards in _replica_groups(leaf).items():
+            if len(shards) < 2:
+                continue
+            ref = np.asarray(shards[0].data).astype(np.float64)
+            for other in shards[1:]:
+                oth = np.asarray(other.data).astype(np.float64)
+                diff = np.abs(oth - ref)
+                # NaN-aware: nan > atol is False, which would report a
+                # NaN-vs-finite replica divergence as "consistent"
+                nan_mismatch = bool((np.isnan(ref) != np.isnan(oth)).any()) if diff.size else False
+                diverged = diff.size and (nan_mismatch or float(np.nanmax(diff) if diff.size else 0) > atol)
+                if diverged:
+                    desc = "nan-mismatch" if nan_mismatch else f"max_dev={float(np.nanmax(diff)):.3e}"
+                    bad.append(f"{leaf_name}[window={window}] {desc} "
+                               f"(devices {shards[0].device} vs {other.device})")
+                    break
+    if bad and raise_error:
+        raise AssertionError(f"replicated shards diverged in {name}: {bad[:8]}")
+    if not bad:
+        logger.debug(f"shard consistency OK for {name}")
+    return bad

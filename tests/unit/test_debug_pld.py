@@ -1,0 +1,125 @@
+"""Sanitizer + progressive-layer-drop tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fast
+
+
+# ---------------- sanitizers ----------------
+def test_assert_all_finite():
+    from deepspeed_tpu.utils.debug import assert_all_finite
+
+    ok = {"a": jnp.ones(4), "b": {"c": jnp.zeros(2)}}
+    assert assert_all_finite(ok) == []
+    bad = {"a": jnp.ones(4), "b": {"c": jnp.asarray([1.0, np.nan])}}
+    with pytest.raises(FloatingPointError, match="b/c"):
+        assert_all_finite(bad)
+    names = assert_all_finite(bad, raise_error=False)
+    assert len(names) == 1 and "b/c" in names[0]
+
+
+def test_shard_consistency_detects_replication():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.utils.debug import check_shard_consistency
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("data",))
+    x = jax.device_put(jnp.arange(16.0), NamedSharding(mesh, P()))  # replicated
+    assert check_shard_consistency({"x": x}) == []
+    y = jax.device_put(jnp.arange(16.0), NamedSharding(mesh, P("data")))  # sharded: no replicas
+    assert check_shard_consistency({"y": y}) == []
+
+
+def test_shard_consistency_after_training_step():
+    """Replicated params stay bit-identical across devices after a real
+    engine step (the SPMD invariant)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.utils.debug import check_shard_consistency
+
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+    })
+    rng = np.random.RandomState(0)
+    loss = engine.forward({"input_ids": rng.randint(0, 1024, size=(8, 16)).astype(np.int32)})
+    engine.backward(loss)
+    engine.step()
+    assert check_shard_consistency(engine.params, "params") == []
+
+
+# ---------------- progressive layer drop ----------------
+def test_pld_schedule():
+    from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert pld.get_theta() == 1.0
+    pld.update_state(100)
+    mid = pld.get_theta()
+    assert 0.5 < mid < 1.0
+    pld.update_state(10**6)
+    np.testing.assert_allclose(pld.get_theta(), 0.5, atol=1e-6)
+    st = pld.get_state()
+    assert st["progressive_layer_drop"] and st["pld_theta"] == pld.get_theta()
+
+
+def test_pld_engine_trains_and_theta_decays():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        "steps_per_print": 10**9,
+    })
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.RandomState(0)
+    thetas = []
+    for i in range(3):
+        loss = engine.forward({"input_ids": rng.randint(0, 1024, size=(8, 16)).astype(np.int32)})
+        engine.backward(loss)
+        engine.step()
+        thetas.append(engine.progressive_layer_drop.get_theta())
+        assert np.isfinite(float(loss))
+    assert thetas[0] > thetas[-1] > 0.5  # decaying toward theta
+
+
+def test_pld_inference_is_deterministic_full_network():
+    """pld only perturbs training: eval/decode use the full network."""
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    ids = np.ones((1, 8), np.int32)
+    a = np.asarray(model.apply(params, ids, train=False))
+    b = np.asarray(model.apply(params, ids, train=False))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_assert_all_finite_bf16():
+    """bf16 (ml_dtypes) leaves must not silently skip the audit."""
+    from deepspeed_tpu.utils.debug import assert_all_finite
+
+    bad = {"w": jnp.asarray([1.0, np.nan], jnp.bfloat16)}
+    with pytest.raises(FloatingPointError, match="w"):
+        assert_all_finite(bad)
+
+
+def test_pld_rejects_scan_layers():
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layers=2, n_heads=2, d_model=16, max_seq_len=32,
+                                       scan_layers=True))
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    with pytest.raises(ValueError, match="scan_layers"):
+        model.module.apply({"params": params}, np.zeros((1, 8), np.int32),
+                           pld_theta=jnp.asarray(0.5), rngs={"pld": jax.random.PRNGKey(0)})
