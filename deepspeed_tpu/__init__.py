@@ -55,7 +55,8 @@ def __getattr__(name):
     import importlib
 
     lazy = {"zero", "moe", "pipe", "sequence", "ops", "models", "inference", "checkpoint", "monitor", "profiling",
-            "elasticity", "compression", "autotuning", "module_inject", "launcher", "runtime"}
+            "elasticity", "compression", "autotuning", "module_inject", "launcher", "runtime", "linear", "comm",
+            "utils", "accelerator"}
     if name in lazy:
         return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -227,6 +227,12 @@ class DeepSpeedEngine:
 
             self.progressive_layer_drop = ProgressiveLayerDrop(theta=pld_cfg.get("theta", 0.5),
                                                                gamma=pld_cfg.get("gamma", 0.001))
+            if not (hasattr(model, "cfg") and hasattr(model, "module")):
+                # theta rides in the batch under the CausalLM convention; a
+                # custom loss_fn that never reads it silently trains at
+                # full depth
+                log_dist("progressive_layer_drop: model does not look like models.CausalLM — "
+                         "ensure its loss_fn consumes batch['pld_theta'] or PLD is a no-op", ranks=[0])
 
         # --- training data ---
         if training_data is not None:

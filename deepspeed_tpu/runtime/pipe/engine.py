@@ -83,6 +83,9 @@ class PipelineEngine(DeepSpeedEngine):
         cfg.resolve_batch_sizes(topo.data_parallel_size)
         if cfg.zero_config.stage > 1:
             raise ValueError("PipelineEngine supports ZeRO stages 0-1 (reference engine.py:1481 contract)")
+        if cfg.pld_config.get("enabled", False):
+            raise ValueError("progressive_layer_drop is not supported under the pipeline engine "
+                             "(stage functions run fixed layer stacks); disable PLD or the pipe mesh")
 
         num_stages = topo.pipe_parallel_size
         if num_stages < 1:

@@ -48,10 +48,14 @@ def assert_all_finite(tree, name: str = "tree", raise_error: bool = True) -> Lis
         # are NOT np.floating subtypes and would silently skip the audit
         if not jnp.issubdtype(arr.dtype, jnp.floating):
             continue
-        arr32 = arr.astype(np.float32)
-        if not np.isfinite(arr32).all():
-            n_nan = int(np.isnan(arr32).sum())
-            n_inf = int(np.isinf(arr32).sum())
+        if np.issubdtype(arr.dtype, np.floating):
+            probe = arr  # np-native (incl. float64): check directly — a
+            # float32 downcast would flag finite 1e300 as inf
+        else:
+            probe = arr.astype(np.float32)  # ml_dtypes upcast losslessly
+        if not np.isfinite(probe).all():
+            n_nan = int(np.isnan(probe).sum())
+            n_inf = int(np.isinf(probe).sum())
             bad.append(f"{leaf_name} (nan={n_nan}, inf={n_inf}, shape={arr.shape})")
     if bad and raise_error:
         raise FloatingPointError(f"non-finite values in {name}: {bad[:8]}"

@@ -123,3 +123,47 @@ def test_pld_rejects_scan_layers():
     with pytest.raises(ValueError, match="scan_layers"):
         model.module.apply({"params": params}, np.zeros((1, 8), np.int32),
                            pld_theta=jnp.asarray(0.5), rngs={"pld": jax.random.PRNGKey(0)})
+
+
+def test_assert_all_finite_float64_no_false_positive():
+    from deepspeed_tpu.utils.debug import assert_all_finite
+
+    assert assert_all_finite({"x": np.array([1e300])}) == []  # finite f64 > f32 max
+
+
+def test_shard_consistency_detects_divergence():
+    """Negative path: replicas with different contents must be flagged."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.utils.debug import check_shard_consistency
+
+    devs = jax.devices()[:2]
+    mesh = Mesh(np.array(devs), ("data",))
+    sharding = NamedSharding(mesh, P())  # replicated over 2 devices
+    a = jax.device_put(np.arange(8.0, dtype=np.float32), devs[0])
+    b = jax.device_put(np.arange(8.0, dtype=np.float32) + 1.0, devs[1])
+    x = jax.make_array_from_single_device_arrays((8,), sharding, [a, b])
+    with pytest.raises(AssertionError, match="diverged"):
+        check_shard_consistency({"x": x})
+    # NaN-vs-finite divergence also flags
+    c = jax.device_put(np.full(8, np.nan, np.float32), devs[1])
+    y = jax.make_array_from_single_device_arrays((8,), sharding, [a, c])
+    names = check_shard_consistency({"y": y}, raise_error=False)
+    assert names and "nan-mismatch" in names[0]
+
+
+def test_pld_rejected_under_pipeline():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    model = CausalLM(TransformerConfig(vocab_size=64, n_layers=2, n_heads=2, d_model=16, max_seq_len=32,
+                                       norm="rmsnorm", activation="swiglu", pos_emb="rope",
+                                       tie_embeddings=False))
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    with pytest.raises(ValueError, match="progressive_layer_drop"):
+        deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+            "progressive_layer_drop": {"enabled": True},
+            "mesh": {"pipe": 2, "data": -1},
+        })
