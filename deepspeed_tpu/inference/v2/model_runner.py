@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ...models.transformer import TransformerConfig, rope_frequencies
+from ...models.transformer import TransformerConfig, alibi_slopes, apply_rope, rope_frequencies
 from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_ref, update_kv_pages)
 
 
@@ -54,19 +54,12 @@ def _proj(x: jnp.ndarray, p: Dict[str, jnp.ndarray], spec: str, dtype) -> jnp.nd
     return y
 
 
-def _apply_rope(x, cos, sin, positions):
-    c = cos[positions][:, :, None, :]
-    s = sin[positions][:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
-    return out.astype(x.dtype)
-
-
 def _mlp(x: jnp.ndarray, p: Dict[str, Any], activation: str, dtype) -> jnp.ndarray:
     if activation == "swiglu":
         h = jax.nn.silu(_proj(x, p["gate_proj"], "bsd,df->bsf", dtype)) * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
     else:
-        h = jax.nn.gelu(_proj(x, p["up_proj"], "bsd,df->bsf", dtype))
+        h = _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
+        h = jax.nn.relu(h) if activation == "relu" else jax.nn.gelu(h)
     return _proj(h, p["down_proj"], "bsf,fd->bsd", dtype)
 
 
@@ -145,11 +138,20 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     x = params["wte"][input_ids].astype(dtype)
     if cfg.pos_emb == "learned":
         x = x + params["wpe"][positions].astype(dtype)
+    norm_key = "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
+    top_norm = 0
+    if cfg.embedding_norm:  # bloom: layernorm right after the embedding
+        x = _norm(x, params[f"{norm_key}_0"], cfg.norm_eps, dtype)
+        top_norm = 1
     cos = sin = None
     if cfg.pos_emb == "rope":
-        cos, sin = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
+    slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
+    # ALiBi decode goes through the gather-based path: the Pallas decode
+    # kernel carries no bias lanes (same stance as flash_attention's
+    # bias fallback)
+    use_pallas_decode = decode and slopes is None
 
-    norm_key = "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
     for i in range(cfg.n_layers):
         lp = params[f"layer_{i}"]
         h = _norm(x, lp[f"{norm_key}_0"], cfg.norm_eps, dtype)
@@ -157,31 +159,42 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
         v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
         if cfg.pos_emb == "rope":
-            q = _apply_rope(q, cos, sin, positions)
-            k = _apply_rope(k, cos, sin, positions)
+            q = apply_rope(q, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
+            k = apply_rope(k, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
 
         kp, vp = update_kv_pages(k_pages[i], v_pages[i], k.reshape(B * S, KVH, D), v.reshape(B * S, KVH, D),
                                  slot_mapping)
         k_pages = k_pages.at[i].set(kp)
         v_pages = v_pages.at[i].set(vp)
 
-        if decode:
+        if use_pallas_decode:
             attn = decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
         else:
-            attn = paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions)
-        x = x + _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
-        h2 = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
-        if _is_moe_layer(cfg, i):
-            x = x + _moe(h2, lp["moe"], cfg, dtype)
-        else:
-            x = x + _mlp(h2, lp["mlp"], cfg.activation, dtype)
+            attn = paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes)
+        attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
 
-    x = _norm(x, params[f"{norm_key}_0"], cfg.norm_eps, dtype)
+        if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
+            ffn_in = h
+        elif cfg.block_type == "parallel":  # gpt-neox parallel residual
+            ffn_in = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
+        else:
+            x = x + attn_out
+            ffn_in = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
+        ffn_out = (_moe(ffn_in, lp["moe"], cfg, dtype) if _is_moe_layer(cfg, i)
+                   else _mlp(ffn_in, lp["mlp"], cfg.activation, dtype))
+        if cfg.block_type in ("parallel", "parallel_shared"):
+            x = x + attn_out + ffn_out
+        else:
+            x = x + ffn_out
+
+    x = _norm(x, params[f"{norm_key}_{top_norm}"], cfg.norm_eps, dtype)
     last = x[jnp.arange(B), last_token_idx, :]
     if cfg.tie_embeddings:
         logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(dtype))
     else:
         logits = jnp.einsum("bd,dv->bv", last, params["lm_head"]["kernel"].astype(dtype))
+        if "bias" in params.get("lm_head", {}):
+            logits = logits + params["lm_head"]["bias"].astype(dtype)
     return logits.astype(jnp.float32), k_pages, v_pages
 
 

@@ -19,6 +19,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from ..ops.attention import attention
@@ -34,9 +35,21 @@ class TransformerConfig:
     d_ff: Optional[int] = None  # default: 4*d_model (gelu) or 8/3*d_model (swiglu)
     max_seq_len: int = 2048
     norm: str = "layernorm"  # layernorm | rmsnorm
-    activation: str = "gelu"  # gelu | swiglu
-    pos_emb: str = "learned"  # learned | rope
+    activation: str = "gelu"  # gelu | swiglu | relu
+    pos_emb: str = "learned"  # learned | rope | alibi | none
     rope_theta: float = 10000.0
+    rotary_pct: float = 1.0  # fraction of head_dim rotated (gpt-neox/phi partial rotary)
+    rotary_dims: Optional[int] = None  # exact rotated dim count (gpt-j rotary_dim); overrides rotary_pct
+    rope_style: str = "neox"  # neox (rotate-half) | gptj (interleaved pairs)
+    # block wiring: sequential (gpt2/llama), parallel (gpt-neox: two norms,
+    # x + attn(ln1 x) + mlp(ln2 x)), parallel_shared (falcon-7b/phi/gpt-j:
+    # one norm feeds both attn and mlp)
+    block_type: str = "sequential"
+    dense_bias: Optional[bool] = None  # default: norm == "layernorm" (falcon: LN but bias-free)
+    qkv_bias: Optional[bool] = None  # override for q/k/v projections only (qwen2)
+    attn_out_bias: Optional[bool] = None  # override for o_proj only (gpt-j: biased MLP, bias-free attn)
+    lm_head_bias: bool = False  # phi / gpt-j carry a bias on the untied head
+    embedding_norm: bool = False  # bloom: layernorm directly after the token embedding
     tie_embeddings: bool = True
     dtype: Any = jnp.float32  # activation/compute dtype
     norm_eps: float = 1e-5
@@ -67,6 +80,25 @@ class TransformerConfig:
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def use_dense_bias(self) -> bool:
+        return self.norm == "layernorm" if self.dense_bias is None else self.dense_bias
+
+    @property
+    def use_qkv_bias(self) -> bool:
+        return self.use_dense_bias if self.qkv_bias is None else self.qkv_bias
+
+    @property
+    def use_attn_out_bias(self) -> bool:
+        return self.use_dense_bias if self.attn_out_bias is None else self.attn_out_bias
+
+    @property
+    def rotary_dim(self) -> int:
+        # even; partial rotary rotates the leading dims
+        if self.rotary_dims is not None:
+            return self.rotary_dims
+        return max(2, int(self.head_dim * self.rotary_pct) // 2 * 2)
 
 
 # -------------------- layers --------------------
@@ -108,13 +140,55 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> Tuple[jnp.nda
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray) -> jnp.ndarray:
-    """x: (B,S,H,D); positions: (B,S) absolute token positions."""
-    c = cos[positions][:, :, None, :]  # (B,S,1,D/2)
+def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray,
+               rotary_dim: Optional[int] = None, style: str = "neox") -> jnp.ndarray:
+    """x: (B,S,H,D); positions: (B,S) absolute token positions.
+
+    ``rotary_dim < D`` rotates only the leading dims (gpt-neox ``rotary_pct``,
+    phi ``partial_rotary_factor``, gpt-j ``rotary_dim``); the tail passes
+    through. ``style``: "neox" rotates half-split pairs (llama/neox/phi),
+    "gptj" rotates adjacent interleaved pairs (gpt-j ``rotate_every_two``).
+    """
+    D = x.shape[-1]
+    rd = D if rotary_dim is None else rotary_dim
+    xr, xp = (x, None) if rd == D else (x[..., :rd], x[..., rd:])
+    c = cos[positions][:, :, None, :]  # (B,S,1,rd/2)
     s = sin[positions][:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
-    return out.astype(x.dtype)
+    xr32 = xr.astype(jnp.float32)
+    if style == "gptj":
+        x1, x2 = xr32[..., 0::2], xr32[..., 1::2]
+        out = jnp.stack([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1).reshape(xr.shape)
+    else:
+        x1, x2 = jnp.split(xr32, 2, axis=-1)
+        out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    out = out.astype(x.dtype)
+    return out if xp is None else jnp.concatenate([out, xp], axis=-1)
+
+
+def alibi_slopes(n_heads: int) -> np.ndarray:
+    """Per-head ALiBi slopes: geometric sequence of 2^(-8/n) for the closest
+    power of two, interpolated for non-power-of-two head counts (ALiBi paper
+    / bloom)."""
+    def slopes(n: int):
+        p = 2**int(np.floor(np.log2(n)))
+        base = [2**(-(2.0**-(np.log2(p) - 3)) * (i + 1)) for i in range(p)]
+        if p < n:
+            base += slopes(2 * p)[0::2][:n - p]
+        return base
+
+    return np.asarray(slopes(n_heads), np.float32)
+
+
+def alibi_bias(n_heads: int, seq_k: int) -> jnp.ndarray:
+    """Shift-invariant ALiBi bias (1, H, 1, Sk): slope_h * k_position.
+
+    Per query row the full form ``slope * (j - i)`` differs from this by a
+    row-constant, which softmax cancels — so this matches bloom exactly
+    while staying O(H*Sk) instead of O(H*Sq*Sk).
+    """
+    sl = jnp.asarray(alibi_slopes(n_heads))  # (H,)
+    pos = jnp.arange(seq_k, dtype=jnp.float32)
+    return (sl[:, None] * pos[None, :])[None, :, None, :]
 
 
 class Attention(nn.Module):
@@ -125,16 +199,17 @@ class Attention(nn.Module):
         cfg = self.cfg
         B, S, _ = x.shape
         H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-        dense = lambda feats, name: nn.DenseGeneral(feats, axis=-1, use_bias=cfg.norm == "layernorm", name=name,
+        dense = lambda feats, name: nn.DenseGeneral(feats, axis=-1, use_bias=cfg.use_qkv_bias, name=name,
                                                     dtype=cfg.dtype, param_dtype=jnp.float32)
         q = dense((H, D), "q_proj")(x)
         k = dense((KVH, D), "k_proj")(x)
         v = dense((KVH, D), "v_proj")(x)
 
         if cfg.pos_emb == "rope":
-            cos, sin = rope_frequencies(D, cfg.max_seq_len, cfg.rope_theta)
-            q = apply_rope(q, cos, sin, positions)
-            k = apply_rope(k, cos, sin, positions)
+            rd = cfg.rotary_dim
+            cos, sin = rope_frequencies(rd, cfg.max_seq_len, cfg.rope_theta)
+            q = apply_rope(q, cos, sin, positions, rotary_dim=rd, style=cfg.rope_style)
+            k = apply_rope(k, cos, sin, positions, rotary_dim=rd, style=cfg.rope_style)
 
         new_cache = None
         kv_len = None
@@ -147,8 +222,11 @@ class Attention(nn.Module):
             kv_len = cache_len + S
             new_cache = (ck, cv, kv_len)
 
-        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len)
-        out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.norm == "layernorm", name="o_proj",
+        bias = None
+        if cfg.pos_emb == "alibi":
+            bias = alibi_bias(H, k.shape[1])
+        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len, bias=bias)
+        out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.use_attn_out_bias, name="o_proj",
                               dtype=cfg.dtype, param_dtype=jnp.float32)(out)
         return (out, new_cache) if kv_cache is not None else out
 
@@ -159,14 +237,14 @@ class MLP(nn.Module):
     @nn.compact
     def __call__(self, x):
         cfg = self.cfg
-        bias = cfg.norm == "layernorm"
+        bias = cfg.use_dense_bias
         if cfg.activation == "swiglu":
             gate = nn.Dense(cfg.ffn_dim, use_bias=bias, name="gate_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
             up = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
             h = nn.silu(gate) * up
         else:
             h = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
-            h = nn.gelu(h)
+            h = nn.relu(h) if cfg.activation == "relu" else nn.gelu(h)
         return nn.Dense(cfg.d_model, use_bias=bias, name="down_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(h)
 
 
@@ -181,26 +259,37 @@ class Block(nn.Module):
         return cfg.moe_num_experts > 0 and (self.layer_idx % max(1, cfg.moe_layer_freq)
                                             == max(1, cfg.moe_layer_freq) - 1)
 
+    def _mlp(self, cfg, h):
+        if self.is_moe:
+            from ..moe.layer import MoE
+
+            return MoE(hidden_size=cfg.d_model, num_experts=cfg.moe_num_experts, k=cfg.moe_top_k,
+                       capacity_factor=cfg.moe_capacity_factor, min_capacity=cfg.moe_min_capacity,
+                       d_ff=cfg.ffn_dim, activation=cfg.activation, dtype=cfg.dtype,
+                       name="moe")(h, train=self.is_training)
+        return MLP(cfg, name="mlp")(h)
+
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, segment_ids=None):
         cfg = self.cfg
         attn = Attention(cfg, name="attn")
-        if kv_cache is not None:
-            a, new_cache = attn(make_norm(cfg)(x), positions, kv_cache, segment_ids)
-        else:
-            a, new_cache = attn(make_norm(cfg)(x), positions, None, segment_ids), None
-        x = x + a
-        h = make_norm(cfg)(x)
-        if self.is_moe:
-            from ..moe.layer import MoE
 
-            mlp_out = MoE(hidden_size=cfg.d_model, num_experts=cfg.moe_num_experts, k=cfg.moe_top_k,
-                          capacity_factor=cfg.moe_capacity_factor, min_capacity=cfg.moe_min_capacity,
-                          d_ff=cfg.ffn_dim, activation=cfg.activation, dtype=cfg.dtype,
-                          name="moe")(h, train=self.is_training)
+        def run_attn(h):
+            if kv_cache is not None:
+                return attn(h, positions, kv_cache, segment_ids)
+            return attn(h, positions, None, segment_ids), None
+
+        if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
+            h = make_norm(cfg)(x)
+            a, new_cache = run_attn(h)
+            x = x + a + self._mlp(cfg, h)
+        elif cfg.block_type == "parallel":  # gpt-neox use_parallel_residual
+            a, new_cache = run_attn(make_norm(cfg)(x))
+            x = x + a + self._mlp(cfg, make_norm(cfg)(x))
         else:
-            mlp_out = MLP(cfg, name="mlp")(h)
-        x = x + mlp_out
+            a, new_cache = run_attn(make_norm(cfg)(x))
+            x = x + a
+            x = x + self._mlp(cfg, make_norm(cfg)(x))
         return (x, new_cache) if kv_cache is not None else x
 
 
@@ -226,6 +315,8 @@ class Transformer(nn.Module):
         if cfg.pos_emb == "learned":
             wpe = self.param("wpe", nn.initializers.normal(0.02), (cfg.max_seq_len, cfg.d_model), jnp.float32)
             x = x + wpe[positions].astype(cfg.dtype)
+        if cfg.embedding_norm:  # bloom word_embeddings_layernorm
+            x = make_norm(cfg)(x)
 
         new_caches = [] if kv_caches is not None else None
         block_cls = Block
@@ -257,7 +348,7 @@ class Transformer(nn.Module):
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(cfg.dtype))
         else:
-            logits = nn.Dense(cfg.vocab_size, use_bias=False, name="lm_head", dtype=cfg.dtype,
+            logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, name="lm_head", dtype=cfg.dtype,
                               param_dtype=jnp.float32)(x)
         logits = logits.astype(jnp.float32)
         return (logits, new_caches) if kv_caches is not None else logits
@@ -325,9 +416,10 @@ class CausalLM:
             hidden = self.apply(params, input_ids, return_hidden=True, **extra)
             aux = 0.0
         if self.cfg.tie_embeddings:
-            w, vd = params["wte"].astype(self.cfg.dtype), True
+            w, vd, head_b = params["wte"].astype(self.cfg.dtype), True, None
         else:
             w, vd = params["lm_head"]["kernel"].astype(self.cfg.dtype), False
+            head_b = params["lm_head"]["bias"] if self.cfg.lm_head_bias else None
         if "labels" in batch:
             labels = batch["labels"]
         else:
@@ -335,7 +427,7 @@ class CausalLM:
             # CE's sequence chunking stays aligned
             labels = jnp.concatenate(
                 [input_ids[:, 1:], jnp.full((input_ids.shape[0], 1), -100, input_ids.dtype)], axis=1)
-        ce = fused_cross_entropy(hidden, w, labels, vd_layout=vd)
+        ce = fused_cross_entropy(hidden, w, labels, vd_layout=vd, bias=head_b)
         return ce + self.cfg.moe_aux_loss_coef * aux
 
     def to_pipeline(self, num_stages: int, params=None, rng=None, example_batch=None):
@@ -359,6 +451,8 @@ class CausalLM:
             raise NotImplementedError("MoE + pipeline composition lands with expert-parallel pipeline support")
         if cfg.scan_layers:
             raise ValueError("disable scan_layers for pipeline (stages are stacked instead)")
+        if cfg.embedding_norm:
+            raise NotImplementedError("embedding_norm (bloom) models are not pipeline-partitionable yet")
         layers_per_stage = cfg.n_layers // num_stages
 
         if params is None:
@@ -407,7 +501,8 @@ class CausalLM:
                 labels = jnp.concatenate([ids[:, 1:], jnp.full((ids.shape[0], 1), -100, ids.dtype)], axis=1)
             if cfg.tie_embeddings:
                 return fused_cross_entropy(x, ps["embed"]["wte"].astype(cfg.dtype), labels, vd_layout=True)
-            return fused_cross_entropy(x, hp["lm_head"]["kernel"].astype(cfg.dtype), labels, vd_layout=False)
+            return fused_cross_entropy(x, hp["lm_head"]["kernel"].astype(cfg.dtype), labels, vd_layout=False,
+                                       bias=hp["lm_head"]["bias"] if cfg.lm_head_bias else None)
 
         base_rules = self.partition_rules()
         rules = [(("stages",) + key, P(*(("pipe",) + tuple(spec)))) for key, spec in base_rules]

@@ -11,9 +11,12 @@ and ``jax.device_put`` with TP/ZeRO shardings so params are born sharded
 (the ``zero.Init.materialize`` path) — no module surgery needed because
 sharding is declarative here.
 
-Supported architectures: ``gpt2`` and the llama family (``llama``,
-``mistral`` — mistral is llama-shaped; sliding-window attention is not
-applied, exact for seq_len <= window).
+Supported architectures (the reference's policy-container breadth,
+``module_inject/containers/`` + ``inference/v2/model_implementations/``):
+``gpt2``, the llama family (``llama``, ``mistral`` — mistral is
+llama-shaped; sliding-window attention is not applied, exact for
+seq_len <= window; ``qwen2``, ``mixtral``), ``opt``, ``gpt_neox``
+(pythia), ``gptj``, ``falcon`` (7b-style), ``phi``, and ``bloom``.
 """
 
 import json
@@ -124,6 +127,8 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("rms_norm_eps", 1e-6),
             dtype=dtype,
         )
+        if model_type == "qwen2":
+            kw["qkv_bias"] = True
         if model_type == "mixtral":
             kw.update(
                 moe_num_experts=hf.get("num_local_experts", 8),
@@ -131,9 +136,134 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                 moe_layer_freq=1,  # every mixtral block is MoE
                 moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
             )
+    elif model_type == "opt":
+        if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
+            raise NotImplementedError("OPT variants with word_embed_proj_dim != hidden_size (350m) "
+                                      "need the embed in/out projections")
+        if not hf.get("do_layer_norm_before", True):
+            raise NotImplementedError("OPT with do_layer_norm_before=False (125m-era post-LN) unsupported")
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 12),
+            n_heads=hf.get("num_attention_heads", 12),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("ffn_dim", 4 * hf["hidden_size"]),
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="relu" if hf.get("activation_function", "relu") == "relu" else "gelu",
+            pos_emb="learned",
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            dtype=dtype,
+        )
+    elif model_type == "gpt_neox":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 12),
+            n_heads=hf.get("num_attention_heads", 12),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size", 4 * hf["hidden_size"]),
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu",
+            pos_emb="rope",
+            rotary_pct=hf.get("rotary_pct", 1.0),
+            rope_theta=hf.get("rotary_emb_base", 10000.0),
+            block_type="parallel" if hf.get("use_parallel_residual", True) else "sequential",
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            dtype=dtype,
+        )
+    elif model_type == "gptj":
+        head_dim = hf["n_embd"] // hf["n_head"]
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("n_layer", 12),
+            n_heads=hf.get("n_head", 12),
+            d_model=hf["n_embd"],
+            d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq_len=hf.get("n_positions", 2048),
+            norm="layernorm",
+            activation="gelu",
+            pos_emb="rope",
+            rotary_dims=hf.get("rotary_dim") or head_dim,
+            rope_style="gptj",
+            block_type="parallel_shared",
+            qkv_bias=False,
+            attn_out_bias=False,
+            dense_bias=True,
+            lm_head_bias=True,
+            tie_embeddings=False,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype,
+        )
+    elif model_type == "falcon":
+        if hf.get("new_decoder_architecture", False):
+            raise NotImplementedError("falcon new_decoder_architecture (40b/180b ln_attn+ln_mlp) unsupported; "
+                                      "7b-style (parallel_attn + multi_query) is")
+        if not hf.get("parallel_attn", True):
+            raise NotImplementedError("falcon with parallel_attn=False unsupported")
+        if not hf.get("multi_query", True):
+            raise NotImplementedError("falcon multi_query=False uses an interleaved qkv layout (rw-style); "
+                                      "unsupported")
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 2),
+            n_heads=hf.get("num_attention_heads", 8),
+            n_kv_heads=1 if hf.get("multi_query", True) else hf.get("num_attention_heads", 8),
+            d_model=hf["hidden_size"],
+            d_ff=4 * hf["hidden_size"],
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu",
+            pos_emb="alibi" if hf.get("alibi", False) else "rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            block_type="parallel_shared",
+            dense_bias=hf.get("bias", False),
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype,
+        )
+    elif model_type == "phi":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 2),
+            n_heads=hf.get("num_attention_heads", 4),
+            n_kv_heads=hf.get("num_key_value_heads") or hf.get("num_attention_heads", 4),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size", 4 * hf["hidden_size"]),
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation="gelu",
+            pos_emb="rope",
+            rotary_pct=hf.get("partial_rotary_factor", 0.5),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            block_type="parallel_shared",
+            dense_bias=True,
+            qkv_bias=True,
+            lm_head_bias=True,
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            dtype=dtype,
+        )
+    elif model_type == "bloom":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("n_layer", 2),
+            n_heads=hf.get("n_head", 8),
+            d_model=hf["hidden_size"],
+            d_ff=4 * hf["hidden_size"],
+            max_seq_len=hf.get("seq_length", 2048),
+            norm="layernorm",
+            activation="gelu",
+            pos_emb="alibi",
+            embedding_norm=True,
+            tie_embeddings=True,
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype,
+        )
     else:
-        raise NotImplementedError(f"HF model_type '{model_type}' not supported "
-                                  "(supported: gpt2, llama, mistral, qwen2, mixtral)")
+        raise NotImplementedError(f"HF model_type '{model_type}' not supported (supported: gpt2, llama, "
+                                  "mistral, qwen2, mixtral, opt, gpt_neox, gptj, falcon, phi, bloom)")
     kw.update(overrides)
     return TransformerConfig(**kw)
 
@@ -254,10 +384,234 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     return params
 
 
+def convert_opt(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``OPTForCausalLM`` -> CausalLM pytree. torch Linear (out,in) is
+    transposed; learned positions drop OPT's 2-slot offset (HF computes
+    positions as mask-cumsum + 2, which for dense masks is arange + 2)."""
+    sd = _strip_prefix(sd, ("model.decoder.", "decoder.", "model."))
+    H, D, dm = cfg.n_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["embed_tokens.weight"],
+        "wpe": sd["embed_positions.weight"][2:2 + cfg.max_seq_len],
+        ln(0): {"scale": sd["final_layer_norm.weight"], "bias": sd["final_layer_norm.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": sd.get("lm_head.weight", sd["embed_tokens.weight"]).T}
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        attn = {}
+        for name, hf_name in (("q_proj", "q_proj"), ("k_proj", "k_proj"), ("v_proj", "v_proj")):
+            attn[name] = {"kernel": sd[p + f"self_attn.{hf_name}.weight"].T.reshape(dm, H, D),
+                          "bias": sd[p + f"self_attn.{hf_name}.bias"].reshape(H, D)}
+        attn["o_proj"] = {"kernel": sd[p + "self_attn.out_proj.weight"].T.reshape(H, D, dm),
+                          "bias": sd[p + "self_attn.out_proj.bias"]}
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "self_attn_layer_norm.weight"], "bias": sd[p + "self_attn_layer_norm.bias"]},
+            ln(1): {"scale": sd[p + "final_layer_norm.weight"], "bias": sd[p + "final_layer_norm.bias"]},
+            "attn": attn,
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "fc1.weight"].T, "bias": sd[p + "fc1.bias"]},
+                "down_proj": {"kernel": sd[p + "fc2.weight"].T, "bias": sd[p + "fc2.bias"]},
+            },
+        }
+    return params
+
+
+def convert_gpt_neox(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``GPTNeoXForCausalLM`` (pythia) -> pytree. The fused
+    ``query_key_value`` is interleaved per head as (H, 3, D, dm)."""
+    sd = _strip_prefix(sd, ("gpt_neox.",))
+    H, D, dm = cfg.n_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["embed_in.weight"],
+        ln(0): {"scale": sd["final_layer_norm.weight"], "bias": sd["final_layer_norm.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["embed_out.weight"].T}
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        qkv_w = sd[p + "attention.query_key_value.weight"].reshape(H, 3, D, dm)
+        qkv_b = sd[p + "attention.query_key_value.bias"].reshape(H, 3, D)
+        attn = {}
+        for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+            attn[name] = {"kernel": np.transpose(qkv_w[:, j], (2, 0, 1)), "bias": qkv_b[:, j]}
+        attn["o_proj"] = {"kernel": sd[p + "attention.dense.weight"].T.reshape(H, D, dm),
+                          "bias": sd[p + "attention.dense.bias"]}
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "input_layernorm.weight"], "bias": sd[p + "input_layernorm.bias"]},
+            ln(1): {"scale": sd[p + "post_attention_layernorm.weight"],
+                    "bias": sd[p + "post_attention_layernorm.bias"]},
+            "attn": attn,
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                            "bias": sd[p + "mlp.dense_h_to_4h.bias"]},
+                "down_proj": {"kernel": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                              "bias": sd[p + "mlp.dense_4h_to_h.bias"]},
+            },
+        }
+    return params
+
+
+def convert_gptj(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``GPTJForCausalLM`` -> pytree: parallel-shared block, interleaved
+    (gptj-style) rotary, biased MLP + biased untied head, bias-free attn."""
+    sd = _strip_prefix(sd, ("transformer.",))
+    H, D, dm = cfg.n_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["wte.weight"],
+        ln(0): {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+        "lm_head": {"kernel": sd["lm_head.weight"].T, "bias": sd["lm_head.bias"]},
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+            "attn": {
+                "q_proj": {"kernel": sd[p + "attn.q_proj.weight"].T.reshape(dm, H, D)},
+                "k_proj": {"kernel": sd[p + "attn.k_proj.weight"].T.reshape(dm, H, D)},
+                "v_proj": {"kernel": sd[p + "attn.v_proj.weight"].T.reshape(dm, H, D)},
+                "o_proj": {"kernel": sd[p + "attn.out_proj.weight"].T.reshape(H, D, dm)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.fc_in.weight"].T, "bias": sd[p + "mlp.fc_in.bias"]},
+                "down_proj": {"kernel": sd[p + "mlp.fc_out.weight"].T, "bias": sd[p + "mlp.fc_out.bias"]},
+            },
+        }
+    return params
+
+
+def convert_falcon(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``FalconForCausalLM`` (7b-style: parallel_attn + multi-query) ->
+    pytree. Fused qkv rows are [q (H*D), k (KVH*D), v (KVH*D)]."""
+    sd = _strip_prefix(sd, ("transformer.",))
+    H, KVH, D, dm = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["word_embeddings.weight"],
+        ln(0): {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        qkv = sd[p + "self_attention.query_key_value.weight"]  # ((H+2*KVH)*D, dm)
+        qw, kw, vw = np.split(qkv, [H * D, (H + KVH) * D], axis=0)
+        layer = {
+            ln(0): {"scale": sd[p + "input_layernorm.weight"], "bias": sd[p + "input_layernorm.bias"]},
+            "attn": {
+                "q_proj": {"kernel": qw.T.reshape(dm, H, D)},
+                "k_proj": {"kernel": kw.T.reshape(dm, KVH, D)},
+                "v_proj": {"kernel": vw.T.reshape(dm, KVH, D)},
+                "o_proj": {"kernel": sd[p + "self_attention.dense.weight"].T.reshape(H, D, dm)},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.dense_h_to_4h.weight"].T},
+                "down_proj": {"kernel": sd[p + "mlp.dense_4h_to_h.weight"].T},
+            },
+        }
+        if cfg.use_dense_bias:
+            qkv_b = sd[p + "self_attention.query_key_value.bias"]
+            qb, kb, vb = np.split(qkv_b, [H * D, (H + KVH) * D])
+            layer["attn"]["q_proj"]["bias"] = qb.reshape(H, D)
+            layer["attn"]["k_proj"]["bias"] = kb.reshape(KVH, D)
+            layer["attn"]["v_proj"]["bias"] = vb.reshape(KVH, D)
+            layer["attn"]["o_proj"]["bias"] = sd[p + "self_attention.dense.bias"]
+            layer["mlp"]["up_proj"]["bias"] = sd[p + "mlp.dense_h_to_4h.bias"]
+            layer["mlp"]["down_proj"]["bias"] = sd[p + "mlp.dense_4h_to_h.bias"]
+        params[f"layer_{i}"] = layer
+    return params
+
+
+def convert_phi(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``PhiForCausalLM`` (phi-1/phi-2) -> pytree: parallel-shared block
+    with one layernorm, partial rotary, biases everywhere incl. lm_head."""
+    has_lm_head = "lm_head.weight" in sd
+    sd = _strip_prefix(sd, ("model.",))
+    H, KVH, D, dm = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["embed_tokens.weight"],
+        ln(0): {"scale": sd["final_layernorm.weight"], "bias": sd["final_layernorm.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        lm_w = sd["lm_head.weight"] if has_lm_head else sd["embed_tokens.weight"]
+        params["lm_head"] = {"kernel": lm_w.T}
+        if cfg.lm_head_bias:
+            params["lm_head"]["bias"] = sd["lm_head.bias"]
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "input_layernorm.weight"], "bias": sd[p + "input_layernorm.bias"]},
+            "attn": {
+                "q_proj": {"kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "self_attn.q_proj.bias"].reshape(H, D)},
+                "k_proj": {"kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(dm, KVH, D),
+                           "bias": sd[p + "self_attn.k_proj.bias"].reshape(KVH, D)},
+                "v_proj": {"kernel": sd[p + "self_attn.v_proj.weight"].T.reshape(dm, KVH, D),
+                           "bias": sd[p + "self_attn.v_proj.bias"].reshape(KVH, D)},
+                "o_proj": {"kernel": sd[p + "self_attn.dense.weight"].T.reshape(H, D, dm),
+                           "bias": sd[p + "self_attn.dense.bias"]},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.fc1.weight"].T, "bias": sd[p + "mlp.fc1.bias"]},
+                "down_proj": {"kernel": sd[p + "mlp.fc2.weight"].T, "bias": sd[p + "mlp.fc2.bias"]},
+            },
+        }
+    return params
+
+
+def convert_bloom(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``BloomForCausalLM`` -> pytree: ALiBi attention, embedding
+    layernorm, per-head-interleaved fused qkv (H, 3, D)."""
+    sd = _strip_prefix(sd, ("transformer.",))
+    H, D, dm = cfg.n_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["word_embeddings.weight"],
+        ln(0): {"scale": sd["word_embeddings_layernorm.weight"], "bias": sd["word_embeddings_layernorm.bias"]},
+        ln(1): {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        qkv_w = sd[p + "self_attention.query_key_value.weight"].reshape(H, 3, D, dm)
+        qkv_b = sd[p + "self_attention.query_key_value.bias"].reshape(H, 3, D)
+        attn = {}
+        for j, name in enumerate(("q_proj", "k_proj", "v_proj")):
+            attn[name] = {"kernel": np.transpose(qkv_w[:, j], (2, 0, 1)), "bias": qkv_b[:, j]}
+        attn["o_proj"] = {"kernel": sd[p + "self_attention.dense.weight"].T.reshape(H, D, dm),
+                          "bias": sd[p + "self_attention.dense.bias"]}
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "input_layernorm.weight"], "bias": sd[p + "input_layernorm.bias"]},
+            ln(1): {"scale": sd[p + "post_attention_layernorm.weight"],
+                    "bias": sd[p + "post_attention_layernorm.bias"]},
+            "attn": attn,
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.dense_h_to_4h.weight"].T,
+                            "bias": sd[p + "mlp.dense_h_to_4h.bias"]},
+                "down_proj": {"kernel": sd[p + "mlp.dense_4h_to_h.weight"].T,
+                              "bias": sd[p + "mlp.dense_4h_to_h.bias"]},
+            },
+        }
+    return params
+
+
+_CONVERTERS = {
+    "gpt2": convert_gpt2,
+    "opt": convert_opt,
+    "gpt_neox": convert_gpt_neox,
+    "gptj": convert_gptj,
+    "falcon": convert_falcon,
+    "phi": convert_phi,
+    "bloom": convert_bloom,
+}
+
+
 def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg: TransformerConfig, model_type: str) -> Dict:
-    if model_type == "gpt2":
-        return convert_gpt2(sd, cfg)
-    return convert_llama(sd, cfg)
+    conv = _CONVERTERS.get(model_type, convert_llama)  # llama/mistral/qwen2/mixtral share one mapping
+    return conv(sd, cfg)
 
 
 # ----------------------------------------------------------------------

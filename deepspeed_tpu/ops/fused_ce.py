@@ -42,13 +42,13 @@ def _project(xs: jnp.ndarray, w: jnp.ndarray, vd_layout: bool) -> jnp.ndarray:
     return jax.lax.dot_general(xs, w, (((2,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _fused_ce_sum(x, w, labels, valid, vd_layout: bool, chunk: int):
-    total, _ = _ce_fwd_scan(x, w, labels, valid, vd_layout, chunk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _fused_ce_sum(x, w, b, labels, valid, vd_layout: bool, chunk: int, has_bias: bool):
+    total, _ = _ce_fwd_scan(x, w, b, labels, valid, vd_layout, chunk, has_bias)
     return total
 
 
-def _ce_fwd_scan(x, w, labels, valid, vd_layout, chunk):
+def _ce_fwd_scan(x, w, b, labels, valid, vd_layout, chunk, has_bias):
     B, S, D = x.shape
     nb = S // chunk
     xs = x.reshape(B, nb, chunk, D).transpose(1, 0, 2, 3)  # (nb, B, C, D)
@@ -58,6 +58,8 @@ def _ce_fwd_scan(x, w, labels, valid, vd_layout, chunk):
     def body(acc, inp):
         xc, lc, vc = inp  # (B,C,D), (B,C), (B,C)
         logits = _project(xc, w, vd_layout)  # (B,C,V) fp32
+        if has_bias:
+            logits = logits + b
         lse = jax.nn.logsumexp(logits, axis=-1)  # (B,C)
         gold = jnp.take_along_axis(logits, lc[..., None], axis=-1)[..., 0]
         nll = jnp.where(vc, lse - gold, 0.0)
@@ -67,13 +69,13 @@ def _ce_fwd_scan(x, w, labels, valid, vd_layout, chunk):
     return total, lses  # lses: (nb, B, C)
 
 
-def _ce_vjp_fwd(x, w, labels, valid, vd_layout, chunk):
-    total, lses = _ce_fwd_scan(x, w, labels, valid, vd_layout, chunk)
-    return total, (x, w, labels, valid, lses)
+def _ce_vjp_fwd(x, w, b, labels, valid, vd_layout, chunk, has_bias):
+    total, lses = _ce_fwd_scan(x, w, b, labels, valid, vd_layout, chunk, has_bias)
+    return total, (x, w, b, labels, valid, lses)
 
 
-def _ce_vjp_bwd(vd_layout, chunk, res, g):
-    x, w, labels, valid, lses = res
+def _ce_vjp_bwd(vd_layout, chunk, has_bias, res, g):
+    x, w, b, labels, valid, lses = res
     B, S, D = x.shape
     V = w.shape[0] if vd_layout else w.shape[1]
     nb = S // chunk
@@ -81,9 +83,12 @@ def _ce_vjp_bwd(vd_layout, chunk, res, g):
     ls = labels.reshape(B, nb, chunk).transpose(1, 0, 2)
     vs = valid.reshape(B, nb, chunk).transpose(1, 0, 2)
 
-    def body(dw_acc, inp):
+    def body(carry, inp):
+        dw_acc, db_acc = carry
         xc, lc, vc, lse = inp
         logits = _project(xc, w, vd_layout)
+        if has_bias:
+            logits = logits + b
         p = jnp.exp(logits - lse[..., None])  # softmax, (B,C,V) fp32
         onehot = jax.nn.one_hot(lc, V, dtype=jnp.float32)
         dlogits = (p - onehot) * jnp.where(vc, g, 0.0)[..., None]  # (B,C,V) fp32
@@ -98,12 +103,15 @@ def _ce_vjp_bwd(vd_layout, chunk, res, g):
             dxc = jax.lax.dot_general(dlogits_c, w, (((2,), (1,)), ((), ())))
             dwc = jax.lax.dot_general(xc, dlogits_c, (((0, 1), (0, 1)), ((), ())),
                                       preferred_element_type=jnp.float32)  # (D,V)
-        return dw_acc + dwc, dxc.astype(xc.dtype)
+        if has_bias:
+            db_acc = db_acc + jnp.sum(dlogits, axis=(0, 1))
+        return (dw_acc + dwc, db_acc), dxc.astype(xc.dtype)
 
     dw0 = jnp.zeros(w.shape, jnp.float32)
-    dw, dxs = jax.lax.scan(body, dw0, (xs, ls, vs, lses))
+    db0 = jnp.zeros((V,), jnp.float32)
+    (dw, db), dxs = jax.lax.scan(body, (dw0, db0), (xs, ls, vs, lses))
     dx = dxs.transpose(1, 0, 2, 3).reshape(B, S, D).astype(x.dtype)
-    return dx, dw.astype(w.dtype), None, None
+    return dx, dw.astype(w.dtype), db.astype(b.dtype), None, None
 
 
 _fused_ce_sum.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
@@ -114,20 +122,25 @@ def fused_cross_entropy(x: jnp.ndarray,
                         labels: jnp.ndarray,
                         ignore_index: int = -100,
                         vd_layout: bool = False,
-                        chunk: Optional[int] = None) -> jnp.ndarray:
-    """Mean token CE of ``x @ w`` against ``labels`` without materializing
-    full logits.
+                        chunk: Optional[int] = None,
+                        bias: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Mean token CE of ``x @ w (+ bias)`` against ``labels`` without
+    materializing full logits.
 
     x: (B, S, D) final hidden states (compute dtype).
     w: (D, V) projection kernel, or (V, D) with ``vd_layout=True`` (tied
        input embedding).
     labels: (B, S) int; positions equal to ``ignore_index`` are masked out.
+    bias: optional (V,) head bias (phi/gpt-j untied heads).
     Matches ``models.transformer.cross_entropy_loss`` numerics (fp32
     logits, mean over valid positions).
     """
     B, S, D = x.shape
+    V = w.shape[0] if vd_layout else w.shape[1]
     chunk = chunk or _pick_chunk(S)
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
-    total = _fused_ce_sum(x, w, safe_labels, valid, bool(vd_layout), int(chunk))
+    has_bias = bias is not None
+    b = bias.astype(jnp.float32) if has_bias else jnp.zeros((V,), jnp.float32)
+    total = _fused_ce_sum(x, w, b, safe_labels, valid, bool(vd_layout), int(chunk), has_bias)
     return total / jnp.maximum(jnp.sum(valid), 1)

@@ -56,11 +56,14 @@ def update_kv_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray, k_new: jnp.ndarr
 # Gather-based reference path (prefill + CPU fallback)
 # ------------------------------------------------------------------
 def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
-                        ctx_lens: jnp.ndarray, q_positions: jnp.ndarray, scale: Optional[float] = None) -> jnp.ndarray:
+                        ctx_lens: jnp.ndarray, q_positions: jnp.ndarray, scale: Optional[float] = None,
+                        alibi_slopes: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Causal attention of q against paged context.
 
     q: (B, S, H, D); block_tables: (B, P); ctx_lens: (B,) total context
     (incl. the S new tokens); q_positions: (B, S) absolute positions.
+    ``alibi_slopes``: optional (H,) per-head slopes — adds the
+    shift-invariant ALiBi bias ``slope_h * key_position`` (bloom serving).
     Returns (B, S, H, D).
     """
     B, S, H, D = q.shape
@@ -76,6 +79,9 @@ def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarr
     qf = q.astype(jnp.float32).reshape(B, S, KVH, G, D) * scale
     s = jnp.einsum("bskgd,blkd->bskgl", qf, k.astype(jnp.float32))
     key_pos = jnp.arange(L, dtype=jnp.int32)[None, None, None, None, :]
+    if alibi_slopes is not None:
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(KVH, G)
+        s = s + sl[None, None, :, :, None] * key_pos.astype(jnp.float32)
     valid = (key_pos < ctx_lens[:, None, None, None, None]) & (key_pos <= q_positions[:, :, None, None, None])
     s = jnp.where(valid, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
