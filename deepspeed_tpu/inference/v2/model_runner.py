@@ -59,7 +59,10 @@ def _mlp(x: jnp.ndarray, p: Dict[str, Any], activation: str, dtype) -> jnp.ndarr
         h = jax.nn.silu(_proj(x, p["gate_proj"], "bsd,df->bsf", dtype)) * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
     else:
         h = _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
-        h = jax.nn.relu(h) if activation == "relu" else jax.nn.gelu(h)
+        if activation == "relu":
+            h = jax.nn.relu(h)
+        else:
+            h = jax.nn.gelu(h, approximate=activation != "gelu_exact")
     return _proj(h, p["down_proj"], "bsf,fd->bsd", dtype)
 
 
@@ -94,8 +97,10 @@ def _moe(x: jnp.ndarray, p: Dict[str, Any], cfg: TransformerConfig, dtype) -> jn
     if cfg.activation == "swiglu":
         g = jax.lax.ragged_dot(xs, ep["wg"].astype(dtype), group_sizes)
         h = jax.nn.silu(g) * h
+    elif cfg.activation == "relu":
+        h = jax.nn.relu(h)
     else:
-        h = jax.nn.gelu(h)
+        h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
     out_s = jax.lax.ragged_dot(h, ep["wo"].astype(dtype), group_sizes)  # (N*k, d)
 
     w_flat = topk_vals.reshape(-1)[order].astype(dtype)

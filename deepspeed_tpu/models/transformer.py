@@ -35,7 +35,7 @@ class TransformerConfig:
     d_ff: Optional[int] = None  # default: 4*d_model (gelu) or 8/3*d_model (swiglu)
     max_seq_len: int = 2048
     norm: str = "layernorm"  # layernorm | rmsnorm
-    activation: str = "gelu"  # gelu | swiglu | relu
+    activation: str = "gelu"  # gelu (tanh approx) | gelu_exact (erf) | swiglu | relu
     pos_emb: str = "learned"  # learned | rope | alibi | none
     rope_theta: float = 10000.0
     rotary_pct: float = 1.0  # fraction of head_dim rotated (gpt-neox/phi partial rotary)
@@ -244,7 +244,10 @@ class MLP(nn.Module):
             h = nn.silu(gate) * up
         else:
             h = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
-            h = nn.relu(h) if cfg.activation == "relu" else nn.gelu(h)
+            if cfg.activation == "relu":
+                h = nn.relu(h)
+            else:  # HF "gelu" is the exact erf form; "gelu_new"/tanh is our default
+                h = nn.gelu(h, approximate=cfg.activation != "gelu_exact")
         return nn.Dense(cfg.d_model, use_bias=bias, name="down_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(h)
 
 

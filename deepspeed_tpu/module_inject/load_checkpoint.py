@@ -90,6 +90,17 @@ def load_hf_state_dict(model_dir: str) -> Dict[str, np.ndarray]:
 # ----------------------------------------------------------------------
 # config mapping
 # ----------------------------------------------------------------------
+def _map_gelu(hf_act: str) -> str:
+    """HF activation-name -> ours. HF 'gelu' is the exact erf GELU
+    (``transformers.activations.GELUActivation``); 'gelu_new'/'gelu_fast'/
+    'gelu_pytorch_tanh' are the tanh approximation our 'gelu' uses."""
+    if hf_act == "relu":
+        return "relu"
+    if hf_act == "gelu":
+        return "gelu_exact"
+    return "gelu"
+
+
 def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerConfig:
     """Map an HF ``config.json`` dict to :class:`TransformerConfig`."""
     import jax.numpy as jnp
@@ -104,7 +115,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             d_model=hf.get("n_embd", 768),
             max_seq_len=hf.get("n_positions", 1024),
             norm="layernorm",
-            activation="gelu",
+            activation=_map_gelu(hf.get("activation_function", "gelu_new")),
             pos_emb="learned",
             tie_embeddings=True,
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
@@ -150,7 +161,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             d_ff=hf.get("ffn_dim", 4 * hf["hidden_size"]),
             max_seq_len=hf.get("max_position_embeddings", 2048),
             norm="layernorm",
-            activation="relu" if hf.get("activation_function", "relu") == "relu" else "gelu",
+            activation=_map_gelu(hf.get("activation_function", "relu")),
             pos_emb="learned",
             tie_embeddings=hf.get("tie_word_embeddings", True),
             dtype=dtype,
@@ -164,10 +175,12 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             d_ff=hf.get("intermediate_size", 4 * hf["hidden_size"]),
             max_seq_len=hf.get("max_position_embeddings", 2048),
             norm="layernorm",
-            activation="gelu",
+            activation=_map_gelu(hf.get("hidden_act", "gelu")),
             pos_emb="rope",
             rotary_pct=hf.get("rotary_pct", 1.0),
-            rope_theta=hf.get("rotary_emb_base", 10000.0),
+            # modern transformers serializes rope_theta as authoritative,
+            # alongside a possibly-stale legacy rotary_emb_base
+            rope_theta=hf.get("rope_theta", hf.get("rotary_emb_base", 10000.0)),
             block_type="parallel" if hf.get("use_parallel_residual", True) else "sequential",
             tie_embeddings=hf.get("tie_word_embeddings", False),
             norm_eps=hf.get("layer_norm_eps", 1e-5),
@@ -183,7 +196,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
             max_seq_len=hf.get("n_positions", 2048),
             norm="layernorm",
-            activation="gelu",
+            activation=_map_gelu(hf.get("activation_function", "gelu_new")),
             pos_emb="rope",
             rotary_dims=hf.get("rotary_dim") or head_dim,
             rope_style="gptj",
@@ -211,10 +224,10 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             n_heads=hf.get("num_attention_heads", 8),
             n_kv_heads=1 if hf.get("multi_query", True) else hf.get("num_attention_heads", 8),
             d_model=hf["hidden_size"],
-            d_ff=4 * hf["hidden_size"],
+            d_ff=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
             norm="layernorm",
-            activation="gelu",
+            activation=_map_gelu(hf.get("activation", "gelu")),
             pos_emb="alibi" if hf.get("alibi", False) else "rope",
             rope_theta=hf.get("rope_theta", 10000.0),
             block_type="parallel_shared",
@@ -233,7 +246,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             d_ff=hf.get("intermediate_size", 4 * hf["hidden_size"]),
             max_seq_len=hf.get("max_position_embeddings", 2048),
             norm="layernorm",
-            activation="gelu",
+            activation=_map_gelu(hf.get("hidden_act", "gelu_new")),
             pos_emb="rope",
             rotary_pct=hf.get("partial_rotary_factor", 0.5),
             rope_theta=hf.get("rope_theta", 10000.0),

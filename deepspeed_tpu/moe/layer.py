@@ -44,8 +44,10 @@ class Experts(nn.Module):
             wg = self.param("wg", init, (E, d, f), jnp.float32)
             g = jnp.einsum("ecd,edf->ecf", x, wg.astype(self.dtype))
             h = nn.silu(g) * h
+        elif self.activation == "relu":
+            h = nn.relu(h)
         else:
-            h = nn.gelu(h)
+            h = nn.gelu(h, approximate=self.activation != "gelu_exact")
         return jnp.einsum("ecf,efd->ecd", h, wo.astype(self.dtype))
 
 
