@@ -1,4 +1,6 @@
 from .layer import Experts, MoE, MOE_PARTITION_RULES
+from .mappings import drop_tokens, gather_tokens
 from .sharded_moe import combine_output, gate_and_dispatch, top1gating, topkgating
 
-__all__ = ["MoE", "Experts", "MOE_PARTITION_RULES", "top1gating", "topkgating", "gate_and_dispatch", "combine_output"]
+__all__ = ["MoE", "Experts", "MOE_PARTITION_RULES", "top1gating", "topkgating", "gate_and_dispatch",
+           "combine_output", "drop_tokens", "gather_tokens"]

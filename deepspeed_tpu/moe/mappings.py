@@ -1,0 +1,65 @@
+"""Expert-TP token redistribution.
+
+Capability parity: reference ``moe/mappings.py`` (``gather_tokens`` /
+``drop_tokens`` + their autograd-symmetric ``_GatherTokens``/``_DropTokens``
+functions, adapted there from Megatron mpu/mappings). Under tensor
+parallelism the non-expert layers hold activations replicated across the
+TP group; running the MoE dispatch on every TP rank would do E× redundant
+work — the reference slices tokens per TP rank before the MoE block
+(``drop_tokens``) and all-gathers them back after (``gather_tokens``).
+
+TPU-native stance: resharding IS the collective. Dropping tokens is a
+sharding-constraint change from replicated to split-over-``tensor`` along
+the token dim; gathering is the constraint back to replicated. Under jit
+GSPMD inserts the slice / all-gather (and their transposed duals in the
+backward pass — the reference's hand-written autograd symmetry comes for
+free from XLA's transfer semantics).
+
+Outside jit the same functions act eagerly through ``jax.device_put``.
+"""
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.mesh import get_mesh_topology
+
+
+def _resolve(topo):
+    return topo if topo is not None else get_mesh_topology(required=False)
+
+
+def _spec(x, dim: int, axis: Optional[str]):
+    parts = [None] * x.ndim
+    parts[dim] = axis
+    return P(*parts)
+
+
+def _constrain(x, dim: int, axis: Optional[str], topo):
+    spec = _spec(x, dim, axis)
+    if isinstance(x, jax.core.Tracer):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
+    return jax.device_put(x, NamedSharding(topo.mesh, spec))
+
+
+def drop_tokens(x, dim: int = 1, topo=None):
+    """Shard ``x`` over the ``tensor`` axis along ``dim`` (each TP rank
+    keeps its 1/tp slice of the tokens). No-op when tp == 1."""
+    topo = _resolve(topo)
+    if topo is None or topo.model_parallel_size <= 1:
+        return x
+    if x.shape[dim] % topo.model_parallel_size != 0:
+        raise ValueError(f"drop_tokens: dim {dim} of shape {x.shape} not divisible by "
+                         f"tp={topo.model_parallel_size}")
+    return _constrain(x, dim, "tensor", topo)
+
+
+def gather_tokens(x, dim: int = 1, topo=None):
+    """Re-replicate ``x`` across the ``tensor`` axis (all-gather of the
+    per-rank token slices). No-op when tp == 1."""
+    topo = _resolve(topo)
+    if topo is None or topo.model_parallel_size <= 1:
+        return x
+    return _constrain(x, dim, None, topo)

@@ -102,10 +102,15 @@ def mlp_gemm(x: jnp.ndarray, residual: jnp.ndarray, input_bias: Optional[jnp.nda
         inter = inter + b_inter.astype(inter.dtype)
     if activation == "gelu":
         inter = jax.nn.gelu(inter)
+    elif activation == "gelu_exact":
+        inter = jax.nn.gelu(inter, approximate=False)
     elif activation == "relu":
         inter = jax.nn.relu(inter)
     elif activation == "silu":
         inter = jax.nn.silu(inter)
+    else:
+        raise ValueError(f"mlp_gemm: unknown activation {activation!r} "
+                         "(expected gelu | gelu_exact | relu | silu)")
     return jnp.matmul(inter, w_out.astype(inter.dtype)), s
 
 

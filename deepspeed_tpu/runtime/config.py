@@ -267,6 +267,20 @@ class DataTypesConfig(DeepSpeedConfigModel):
 
 
 @dataclass
+class EigenvalueConfig(DeepSpeedConfigModel):
+    """Reference ``runtime/config.py:564 get_eigenvalue_config`` (MoQ
+    curvature signal; consumed by ``runtime/eigenvalue.py``)."""
+    enabled: bool = False
+    verbose: bool = False
+    max_iter: int = 100
+    tol: float = 1e-2
+    stability: float = 1e-6
+    gas_boundary_resolution: int = 1
+    layer_name: str = "layer_"
+    layer_num: int = 0
+
+
+@dataclass
 class AutotuningConfig(DeepSpeedConfigModel):
     """Reference: ``autotuning/config.py``."""
     enabled: bool = False
@@ -359,6 +373,7 @@ class DeepSpeedConfig:
         self.autotuning = AutotuningConfig.from_dict(d.get("autotuning", {}))
         self.elasticity = ElasticityConfig.from_dict(d.get("elasticity", {}))
         self.compression_config = d.get("compression_training", {})
+        self.eigenvalue = EigenvalueConfig.from_dict(d.get("eigenvalue", {}))
         self.data_efficiency_config = d.get("data_efficiency", {})
         # legacy curriculum section (reference constants.py CURRICULUM_LEARNING_LEGACY)
         self.curriculum_learning_legacy = d.get("curriculum_learning", {})

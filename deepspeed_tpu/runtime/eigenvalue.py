@@ -1,0 +1,131 @@
+"""Hessian-eigenvalue estimation (MoQ curvature signal).
+
+Capability parity: reference ``runtime/eigenvalue.py`` — per-layer power
+iteration on the loss Hessian, whose dominant eigenvalue modulates the
+mixed-precision quantization schedule (engine wiring at reference
+``engine.py:217,335``; consumed by the quantizer via ``block_eigenvalue``).
+
+The torch version needs retained double-backward graphs
+(``torch.autograd.grad(grads, params, grad_outputs=v)``); the JAX version
+is a forward-over-reverse Hessian-vector product —
+``jvp(grad(loss restricted to one layer block))`` — compiled once per
+layer shape and reused across power-iteration steps. Convergence control
+(relative tolerance on the Rayleigh quotient, ``max_iter`` cap) runs on
+host: this is an occasional diagnostic at gradient-accumulation
+boundaries (``gas_boundary_resolution``), not a training-step hot path.
+"""
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.logging import log_dist
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100, tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1, layer_name: str = "layer_", layer_num: int = 0):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+        self.layer_name = layer_name
+        self.layer_num = layer_num
+        self._hvp_cache: Dict[str, Callable] = {}
+        log_dist(
+            f"enabled eigenvalue with verbose={verbose}, max_iter={max_iter}, tol={tol}, "
+            f"stability={stability}, gas_boundary_resolution={gas_boundary_resolution}, "
+            f"layer_name={layer_name}, layer_num={layer_num}", ranks=[0])
+
+    # ------------------------------------------------------------------
+    def _layer_keys(self, params: Dict[str, Any]):
+        if self.layer_num > 0:
+            keys = [f"{self.layer_name}{i}" for i in range(self.layer_num)]
+            missing = [k for k in keys if k not in params]
+            if missing:
+                raise KeyError(f"eigenvalue layer blocks not found in params: {missing}")
+            return keys
+        return sorted((k for k in params if k.startswith(self.layer_name)),
+                      key=lambda k: int(k[len(self.layer_name):]) if k[len(self.layer_name):].isdigit() else 0)
+
+    def _hvp_fn(self, loss_fn, key: str):
+        """Compiled HVP for one layer block: (block, v, params, batch, rng)
+        -> H_block v. Params/batch/rng are traced arguments so the compiled
+        function stays valid across training steps; ``loss_fn`` must be the
+        same callable across calls (the engine passes its bound loss) — a
+        fresh lambda per call would defeat the cache, not break it."""
+        if key not in self._hvp_cache:
+            import inspect
+
+            try:
+                takes_rng = len(inspect.signature(loss_fn).parameters) >= 3
+            except (TypeError, ValueError):
+                takes_rng = True
+            call = loss_fn if takes_rng else (lambda p, b, r: loss_fn(p, b))
+
+            def hvp(block_params, v, params, batch, rng):
+                def block_grad(bp):
+                    merged = dict(params)
+                    merged[key] = bp
+                    return jax.grad(lambda p: call(p, batch, rng))(merged)[key]
+
+                return jax.jvp(block_grad, (block_params,), (v,))[1]
+
+            self._hvp_cache[key] = jax.jit(hvp)
+        return self._hvp_cache[key]
+
+    @staticmethod
+    def _inner(a, b) -> jnp.ndarray:
+        return sum(jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32))
+                   for x, y in zip(jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)))
+
+    def _normalize(self, v):
+        norm = jnp.sqrt(self._inner(v, v)) + self.stability
+        return jax.tree_util.tree_map(lambda x: jnp.nan_to_num(x / norm, posinf=0.0, neginf=0.0), v)
+
+    # ------------------------------------------------------------------
+    def compute_eigenvalue(self, loss_fn: Callable, params: Dict[str, Any], batch,
+                           rng: Optional[jax.Array] = None, scale: float = 1.0,
+                           loss_rng: Optional[jax.Array] = None) -> Dict[str, float]:
+        """Dominant Hessian eigenvalue per layer block.
+
+        ``loss_fn(params, batch)`` (or ``(params, batch, rng)``) must be
+        differentiable in ``params``; ``loss_rng`` feeds a 3-arg loss (e.g.
+        dropout keys). ``rng`` seeds the power-iteration start vectors.
+        Returns ``{layer_key: eigenvalue * scale}`` with the reference's
+        post-processing: non-finite -> 0, then 0 -> max over blocks (a
+        conservative stand-in so downstream quantization never divides by
+        a spuriously small curvature).
+        """
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        loss_rng = loss_rng if loss_rng is not None else jax.random.PRNGKey(0)
+        out: Dict[str, float] = {}
+        for key in self._layer_keys(params):
+            hvp = self._hvp_fn(loss_fn, key)
+            rng, sub = jax.random.split(rng)
+            leaves, treedef = jax.tree_util.tree_flatten(params[key])
+            subs = jax.random.split(sub, len(leaves))
+            v = jax.tree_util.tree_unflatten(
+                treedef, [jax.random.normal(s, l.shape, jnp.float32) for s, l in zip(subs, leaves)])
+
+            ev_cur, ev_prev = 1.0, 0.0
+            for i in range(self.max_iter):
+                v = self._normalize(v)
+                hv = hvp(params[key], v, params, batch, loss_rng)
+                ev_prev, ev_cur = ev_cur, float(self._inner(v, hv))
+                v = hv
+                if abs(ev_cur) == 0.0 or abs((ev_cur - ev_prev) / (ev_cur + 1e-30)) < self.tol:
+                    break
+            if self.verbose:
+                log_dist(f"eigenvalue[{key}] = {ev_cur:.6g} ({i + 1} iters)", ranks=[0])
+            out[key] = ev_cur * scale
+
+        # reference post-processing (eigenvalue.py: replace nan/inf with 0,
+        # then 0 with the max eigenvalue across blocks)
+        vals = np.asarray([0.0 if not np.isfinite(v) else v for v in out.values()])
+        if vals.size and vals.max() > 0:
+            vals[vals == 0.0] = vals.max()
+        return {k: float(v) for k, v in zip(out, vals)}

@@ -248,6 +248,19 @@ class DeepSpeedEngine:
             self.compression_engine = CompressionEngine(self.params, self.config.compression_config,
                                                         num_heads=getattr(model_cfg, "n_heads", None))
 
+        # Hessian-eigenvalue curvature signal (reference engine.py:217,335)
+        self.eigenvalue = None
+        self.block_eigenvalue: Dict[str, float] = {}
+        if self.config.eigenvalue.enabled:
+            from .eigenvalue import Eigenvalue
+
+            ev = self.config.eigenvalue
+            n_layers = ev.layer_num or getattr(getattr(model, "cfg", None), "n_layers", 0)
+            self.eigenvalue = Eigenvalue(verbose=ev.verbose, max_iter=ev.max_iter, tol=ev.tol,
+                                         stability=ev.stability,
+                                         gas_boundary_resolution=ev.gas_boundary_resolution,
+                                         layer_name=ev.layer_name, layer_num=n_layers)
+
         # reference wires checkpointing.configure from the engine too;
         # unconditional so a previous engine's flags never leak into this
         # one through the module-level config
@@ -400,6 +413,8 @@ class DeepSpeedEngine:
         loss, grads = self._fwd_bwd(self.params, batch, self.micro_steps, scale)
         self._cached_grads = grads
         self._last_loss = loss
+        if self.eigenvalue is not None:
+            self._last_batch = batch  # retained for the gas-boundary eigenvalue pass
         if profiling:
             self._stop_flops_profile()
         self.timers(FORWARD_GLOBAL_TIMER).stop()
@@ -430,6 +445,16 @@ class DeepSpeedEngine:
         if not self.is_gradient_accumulation_boundary():
             return
         self.timers(STEP_GLOBAL_TIMER).start()
+        if (self.eigenvalue is not None
+                and self.global_steps % self.eigenvalue.gas_boundary_resolution == 0
+                and getattr(self, "_last_batch", None) is not None):
+            # curvature signal at the accumulation boundary (ref engine.py:2029).
+            # _loss_fn is a stable bound callable, so the per-layer HVP jits
+            # compile once; the step-derived rng feeds dropout-style losses.
+            params_c = _cast_tree(self.params, self.compute_dtype)
+            self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
+                self._loss_fn, params_c, self._last_batch,
+                loss_rng=jax.random.fold_in(self._rng, self.global_steps))
         lr = self._next_lr()
         # grads were pre-scaled by loss_scale/gas in forward; undo loss_scale
         # here (the 1/gas factor stays: summed micro-grads become the mean)

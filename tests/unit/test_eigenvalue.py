@@ -1,0 +1,137 @@
+"""Eigenvalue (MoQ curvature) + expert-TP token mappings.
+
+Oracle style per SURVEY.md §4: power iteration against analytically known
+Hessians (reference ``runtime/eigenvalue.py`` contract), sharding-level
+checks for ``moe.mappings`` (reference ``moe/mappings.py``)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fast
+
+
+def test_eigenvalue_quadratic_oracle():
+    """loss = 0.5 x^T A x has Hessian A: power iteration must find its
+    dominant eigenvalue per layer block."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    rng = np.random.RandomState(0)
+    evs = {}
+    params = {}
+    for i, n in enumerate((4, 6)):
+        q, _ = np.linalg.qr(rng.randn(n, n))
+        lam = np.sort(np.abs(rng.randn(n)))[::-1] * (i + 1)
+        a = q @ np.diag(lam) @ q.T
+        params[f"layer_{i}"] = {"x": jnp.asarray(rng.randn(n), jnp.float32)}
+        evs[f"layer_{i}"] = (jnp.asarray(a, jnp.float32), float(lam[0]))
+
+    def loss_fn(p, batch):
+        return sum(0.5 * p[k]["x"] @ evs[k][0] @ p[k]["x"] for k in evs)
+
+    e = Eigenvalue(max_iter=500, tol=1e-5, layer_name="layer_", layer_num=2)
+    got = e.compute_eigenvalue(loss_fn, params, batch=None)
+    for k, (_, lam0) in evs.items():
+        assert abs(got[k] - lam0) / lam0 < 5e-2, (k, got[k], lam0)
+
+
+def test_eigenvalue_nonfinite_replaced_with_max():
+    """Reference post-processing: nan/inf -> 0 -> max over blocks."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    params = {"layer_0": {"x": jnp.ones((3,))}, "layer_1": {"x": jnp.ones((3,))}}
+
+    def loss_fn(p, batch):
+        # layer_0: well-behaved quadratic (H = 2I -> ev 2); layer_1: linear
+        # (H = 0 -> ev 0, replaced by the max)
+        return jnp.sum(p["layer_0"]["x"]**2) + jnp.sum(p["layer_1"]["x"])
+
+    e = Eigenvalue(max_iter=50, tol=1e-4, layer_name="layer_", layer_num=2)
+    got = e.compute_eigenvalue(loss_fn, params, batch=None)
+    assert abs(got["layer_0"] - 2.0) < 1e-3
+    assert got["layer_1"] == pytest.approx(got["layer_0"])
+
+
+def test_eigenvalue_tracks_fresh_params():
+    """The cached per-layer HVP must see each call's params, not the first
+    call's (regression: jit closure baked in stale params/batch)."""
+    from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+    def loss_fn(p, batch):
+        return 0.5 * batch["c"] * jnp.sum(p["layer_0"]["x"]**2 * p["layer_0"]["s"])
+
+    e = Eigenvalue(max_iter=100, tol=1e-5, layer_name="layer_", layer_num=1)
+    params = {"layer_0": {"x": jnp.ones((4,)), "s": jnp.asarray([1.0, 2.0, 3.0, 4.0])}}
+    got1 = e.compute_eigenvalue(loss_fn, params, {"c": jnp.asarray(1.0)})
+    # H = diag(c * s) over x and more wrt s-cross terms; dominant >= 4*c
+    params2 = {"layer_0": {"x": jnp.ones((4,)), "s": jnp.asarray([1.0, 2.0, 3.0, 4.0])}}
+    got2 = e.compute_eigenvalue(loss_fn, params2, {"c": jnp.asarray(10.0)})
+    assert got2["layer_0"] > 5 * got1["layer_0"], (got1, got2)
+
+
+def test_autotuning_config_parses():
+    """Regression: a decorator slip left AutotuningConfig field-less and
+    silently dropping user settings."""
+    from deepspeed_tpu.runtime.config import AutotuningConfig
+
+    c = AutotuningConfig.from_dict({"enabled": True, "metric": "latency"})
+    assert c.enabled is True and c.metric == "latency"
+
+
+def test_engine_eigenvalue_wiring():
+    """engine.block_eigenvalue populates at the gas boundary when enabled."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "eigenvalue": {"enabled": True, "max_iter": 4, "tol": 1e-1}})
+    assert engine.eigenvalue is not None
+    batch = engine._put_batch({"input_ids": np.random.RandomState(0).randint(0, 1024, (8, 16)).astype(np.int32)})
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    assert set(engine.block_eigenvalue) == {"layer_0", "layer_1"}
+    assert all(np.isfinite(v) for v in engine.block_eigenvalue.values())
+
+
+def test_moe_token_mappings_shardings():
+    from deepspeed_tpu.moe import drop_tokens, gather_tokens
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    topo = initialize_mesh(MeshConfig.from_dict({"data": 4, "tensor": 2}), force=True)
+    x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+    dropped = drop_tokens(x, dim=1, topo=topo)
+    spec = dropped.sharding.spec
+    assert spec[1] == "tensor", spec
+    gathered = gather_tokens(dropped, dim=1, topo=topo)
+    assert all(s is None for s in gathered.sharding.spec), gathered.sharding.spec
+    np.testing.assert_array_equal(np.asarray(gathered), np.asarray(x))
+
+    # inside jit: constraints compile and round-trip exactly
+    f = jax.jit(lambda x: gather_tokens(drop_tokens(x, dim=1, topo=topo), dim=1, topo=topo) * 2.0)
+    np.testing.assert_array_equal(np.asarray(f(x)), np.asarray(x) * 2.0)
+
+    # tp=1 mesh: pure passthrough
+    topo1 = initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+    y = drop_tokens(x, dim=1, topo=topo1)
+    assert y is x
+
+
+def test_drop_tokens_divisibility_error():
+    from deepspeed_tpu.moe import drop_tokens
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    topo = initialize_mesh(MeshConfig.from_dict({"data": 4, "tensor": 2}), force=True)
+    with pytest.raises(ValueError, match="not divisible"):
+        drop_tokens(jnp.ones((2, 7, 4)), dim=1, topo=topo)
