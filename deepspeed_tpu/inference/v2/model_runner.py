@@ -33,79 +33,8 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import TransformerConfig, alibi_slopes, apply_rope, rope_frequencies
-from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_ref, update_kv_pages)
-
-
-def _norm(x: jnp.ndarray, p: Dict[str, jnp.ndarray], eps: float, dtype) -> jnp.ndarray:
-    x32 = x.astype(jnp.float32)
-    if "bias" in p:  # layernorm
-        mean = jnp.mean(x32, axis=-1, keepdims=True)
-        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
-        y = (x32 - mean) * jax.lax.rsqrt(var + eps)
-        return (y * p["scale"] + p["bias"]).astype(dtype)
-    y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps)
-    return (y * p["scale"]).astype(dtype)
-
-
-def _proj(x: jnp.ndarray, p: Dict[str, jnp.ndarray], spec: str, dtype) -> jnp.ndarray:
-    y = jnp.einsum(spec, x, p["kernel"].astype(dtype))
-    if "bias" in p:
-        y = y + p["bias"].astype(dtype)
-    return y
-
-
-def _mlp(x: jnp.ndarray, p: Dict[str, Any], activation: str, dtype) -> jnp.ndarray:
-    if activation == "swiglu":
-        h = jax.nn.silu(_proj(x, p["gate_proj"], "bsd,df->bsf", dtype)) * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
-    else:
-        h = _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
-        if activation == "relu":
-            h = jax.nn.relu(h)
-        else:
-            h = jax.nn.gelu(h, approximate=activation != "gelu_exact")
-    return _proj(h, p["down_proj"], "bsf,fd->bsd", dtype)
-
-
-def _moe(x: jnp.ndarray, p: Dict[str, Any], cfg: TransformerConfig, dtype) -> jnp.ndarray:
-    """MoE FFN in serving mode — ragged grouped matmuls, never dropping a
-    token (the reference's ``moe_scatter``/``moe_gather``/``top_k_gating``
-    ragged kernels, ``inference/v2/kernels/ragged_ops/``).
-
-    Tokens sort by expert and run through ``lax.ragged_dot`` grouped
-    GEMMs: O(N*k) memory, vs the training layer's capacity-dense
-    (N, E, C) dispatch which is quadratic in N when no-drop forces C=N.
-    Output math matches the training gate exactly (top-1 uses the raw
-    softmax prob; top-k>1 normalizes the k weights), so serving equals
-    the dense oracle."""
-    B, S, d = x.shape
-    k, E = cfg.moe_top_k, cfg.moe_num_experts
-    tokens = x.reshape(-1, d)
-    N = tokens.shape[0]
-    gates = jax.nn.softmax(tokens.astype(jnp.float32) @ p["gate"]["kernel"].astype(jnp.float32), axis=-1)
-    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # (N, k)
-    if k > 1:  # training parity: topkgating normalizes, top1gating does not
-        topk_vals = topk_vals / jnp.maximum(jnp.sum(topk_vals, axis=-1, keepdims=True), 1e-9)
-
-    flat_e = topk_idx.reshape(-1)  # (N*k,)
-    order = jnp.argsort(flat_e)  # stable: preserves token order within an expert
-    tok_of = order // k
-    xs = tokens[tok_of].astype(dtype)  # (N*k, d) sorted by expert
-    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
-
-    ep = p["experts"]
-    h = jax.lax.ragged_dot(xs, ep["wi"].astype(dtype), group_sizes)
-    if cfg.activation == "swiglu":
-        g = jax.lax.ragged_dot(xs, ep["wg"].astype(dtype), group_sizes)
-        h = jax.nn.silu(g) * h
-    elif cfg.activation == "relu":
-        h = jax.nn.relu(h)
-    else:
-        h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
-    out_s = jax.lax.ragged_dot(h, ep["wo"].astype(dtype), group_sizes)  # (N*k, d)
-
-    w_flat = topk_vals.reshape(-1)[order].astype(dtype)
-    out = jnp.zeros((N, d), dtype).at[tok_of].add(out_s * w_flat[:, None])
-    return out.reshape(B, S, d)
+from ...ops.pallas.paged_attention import paged_attention_decode, update_kv_pages
+from .modules import _norm_key, _proj, build_modules
 
 
 def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
@@ -140,26 +69,20 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     else:
         decode_attn = functools.partial(paged_attention_decode, interpret=interpret)
 
-    x = params["wte"][input_ids].astype(dtype)
-    if cfg.pos_emb == "learned":
-        x = x + params["wpe"][positions].astype(dtype)
-    norm_key = "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
-    top_norm = 0
-    if cfg.embedding_norm:  # bloom: layernorm right after the embedding
-        x = _norm(x, params[f"{norm_key}_0"], cfg.norm_eps, dtype)
-        top_norm = 1
+    mods = build_modules()
+    x = mods.embedding(cfg, params, input_ids, positions)
+    norm_key = _norm_key(cfg)
     cos = sin = None
     if cfg.pos_emb == "rope":
         cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
-    slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
-    # ALiBi decode goes through the gather-based path: the Pallas decode
-    # kernel carries no bias lanes (same stance as flash_attention's
+    # ALiBi decode goes through the gather-based attention path: the Pallas
+    # decode kernel carries no bias lanes (same stance as flash_attention's
     # bias fallback)
-    use_pallas_decode = decode and slopes is None
+    slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
 
     for i in range(cfg.n_layers):
         lp = params[f"layer_{i}"]
-        h = _norm(x, lp[f"{norm_key}_0"], cfg.norm_eps, dtype)
+        h = mods.norm(cfg, lp[f"{norm_key}_0"], x)
         q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
         k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
         v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
@@ -172,35 +95,24 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         k_pages = k_pages.at[i].set(kp)
         v_pages = v_pages.at[i].set(vp)
 
-        if use_pallas_decode:
-            attn = decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
-        else:
-            attn = paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes)
+        attn = mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
+                              slopes=slopes, decode_attn=decode_attn)
         attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
 
         if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
             ffn_in = h
         elif cfg.block_type == "parallel":  # gpt-neox parallel residual
-            ffn_in = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
+            ffn_in = mods.norm(cfg, lp[f"{norm_key}_1"], x)
         else:
             x = x + attn_out
-            ffn_in = _norm(x, lp[f"{norm_key}_1"], cfg.norm_eps, dtype)
-        ffn_out = (_moe(ffn_in, lp["moe"], cfg, dtype) if _is_moe_layer(cfg, i)
-                   else _mlp(ffn_in, lp["mlp"], cfg.activation, dtype))
+            ffn_in = mods.norm(cfg, lp[f"{norm_key}_1"], x)
+        ffn_out = mods.moe(cfg, lp["moe"], ffn_in) if _is_moe_layer(cfg, i) else mods.mlp(cfg, lp["mlp"], ffn_in)
         if cfg.block_type in ("parallel", "parallel_shared"):
             x = x + attn_out + ffn_out
         else:
             x = x + ffn_out
 
-    x = _norm(x, params[f"{norm_key}_{top_norm}"], cfg.norm_eps, dtype)
-    last = x[jnp.arange(B), last_token_idx, :]
-    if cfg.tie_embeddings:
-        logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(dtype))
-    else:
-        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"]["kernel"].astype(dtype))
-        if "bias" in params.get("lm_head", {}):
-            logits = logits + params["lm_head"]["bias"].astype(dtype)
-    return logits.astype(jnp.float32), k_pages, v_pages
+    return mods.unembed(cfg, params, x, last_token_idx), k_pages, v_pages
 
 
 def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):

@@ -1,0 +1,165 @@
+"""Swappable serving modules for the v2 (ragged / continuous-batching) engine.
+
+Capability parity: reference ``inference/v2/modules/interfaces/`` — the
+attention/embedding/linear/moe/pre_norm/post_norm/unembed base classes with
+registry-selected implementations (``v2/modules/implementations/``,
+``heuristics.py`` picks one per config). The TPU-native counterpart reuses
+the framework's single kernel registry (``ops/registry.py``): each module
+is an op family (``v2_embedding``, ``v2_attention``, ``v2_mlp``,
+``v2_moe``, ``v2_norm``, ``v2_unembed``) whose default "tpu"
+implementation is registered here; alternates register at higher priority
+or are forced via ``REGISTRY.set_impl`` / ``DS_TPU_OP_V2_*`` env — the
+same selection semantics the rest of the framework uses, so `ds_tpu_report`
+shows serving-module choices alongside kernels.
+
+Module contracts (all pure functions over the flax param pytree):
+- embedding(cfg, params, input_ids, positions) -> (B, S, d) hidden
+- norm(cfg, p, x) -> normed x        (pre_norm/post_norm collapse to one)
+- attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, *, decode,
+  slopes, decode_attn) -> (B, S, H, D) context
+- mlp(cfg, p, x) -> (B, S, d)
+- moe(cfg, p, x) -> (B, S, d)        (no-drop ragged dispatch)
+- unembed(cfg, params, x, last_token_idx) -> (B, V) fp32 logits
+"""
+
+from typing import Any, Callable, Dict, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import TransformerConfig
+from ...ops.pallas.paged_attention import paged_attention_ref
+from ...ops.registry import REGISTRY
+
+
+def _norm_key(cfg: TransformerConfig) -> str:
+    return "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
+
+
+def _proj(x, p, spec, dtype):
+    y = jnp.einsum(spec, x, p["kernel"].astype(dtype))
+    if "bias" in p:
+        y = y + p["bias"].astype(dtype)
+    return y
+
+
+# ----------------------------------------------------------------------
+# default implementations (ref v2/modules/implementations/*)
+# ----------------------------------------------------------------------
+def embedding_tpu(cfg: TransformerConfig, params: Dict[str, Any], input_ids, positions):
+    """ref ``implementations/embedding/ragged_embedding.py``."""
+    x = params["wte"][input_ids].astype(cfg.dtype)
+    if cfg.pos_emb == "learned":
+        x = x + params["wpe"][positions].astype(cfg.dtype)
+    if cfg.embedding_norm:  # bloom
+        x = norm_tpu(cfg, params[f"{_norm_key(cfg)}_0"], x)
+    return x
+
+
+def norm_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
+    """ref ``implementations/{pre_norm,post_norm}/``: one fused norm serves
+    both roles (the pre/post distinction is call-site placement here)."""
+    if "bias" in p:
+        return REGISTRY.get("layer_norm")(x, p["scale"], p["bias"], cfg.norm_eps).astype(cfg.dtype)
+    return REGISTRY.get("rms_norm")(x, p["scale"], cfg.norm_eps).astype(cfg.dtype)
+
+
+def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, positions, *, decode: bool,
+                  slopes=None, decode_attn: Callable = None):
+    """ref ``implementations/attention/dense_blocked_attention.py``: Pallas
+    paged decode on the hot path, gather-based reference attention for
+    prefill and for bias-carrying (ALiBi) models."""
+    if decode and slopes is None and decode_attn is not None:
+        return decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
+    return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes)
+
+
+def mlp_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
+    """ref ``implementations/linear/*``: the dense FFN pair."""
+    dtype = cfg.dtype
+    if cfg.activation == "swiglu":
+        h = jax.nn.silu(_proj(x, p["gate_proj"], "bsd,df->bsf", dtype)) * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
+    else:
+        h = _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
+        if cfg.activation == "relu":
+            h = jax.nn.relu(h)
+        else:
+            h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
+    return _proj(h, p["down_proj"], "bsf,fd->bsd", dtype)
+
+
+def moe_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
+    """ref ``implementations/moe/cutlass_multi_gemm.py`` (+ the ragged
+    moe_scatter/top_k_gating kernels): no-drop top-k dispatch through
+    ``lax.ragged_dot`` grouped GEMMs; math matches the training gate."""
+    dtype = cfg.dtype
+    B, S, d = x.shape
+    k, E = cfg.moe_top_k, cfg.moe_num_experts
+    tokens = x.reshape(-1, d)
+    N = tokens.shape[0]
+    gates = jax.nn.softmax(tokens.astype(jnp.float32) @ p["gate"]["kernel"].astype(jnp.float32), axis=-1)
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)  # (N, k)
+    if k > 1:  # training parity: topkgating normalizes, top1gating does not
+        topk_vals = topk_vals / jnp.maximum(jnp.sum(topk_vals, axis=-1, keepdims=True), 1e-9)
+
+    flat_e = topk_idx.reshape(-1)  # (N*k,)
+    order = jnp.argsort(flat_e)  # stable: preserves token order within an expert
+    tok_of = order // k
+    xs = tokens[tok_of].astype(dtype)  # (N*k, d) sorted by expert
+    group_sizes = jnp.bincount(flat_e, length=E).astype(jnp.int32)
+
+    ep = p["experts"]
+    h = jax.lax.ragged_dot(xs, ep["wi"].astype(dtype), group_sizes)
+    if cfg.activation == "swiglu":
+        g = jax.lax.ragged_dot(xs, ep["wg"].astype(dtype), group_sizes)
+        h = jax.nn.silu(g) * h
+    elif cfg.activation == "relu":
+        h = jax.nn.relu(h)
+    else:
+        h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
+    out_s = jax.lax.ragged_dot(h, ep["wo"].astype(dtype), group_sizes)  # (N*k, d)
+
+    w_flat = topk_vals.reshape(-1)[order].astype(dtype)
+    out = jnp.zeros((N, d), dtype).at[tok_of].add(out_s * w_flat[:, None])
+    return out.reshape(B, S, d)
+
+
+def unembed_tpu(cfg: TransformerConfig, params: Dict[str, Any], x, last_token_idx):
+    """ref ``implementations/unembed/ragged_unembed.py``: final norm +
+    last-real-token logits gather + head projection."""
+    top = 1 if cfg.embedding_norm else 0
+    x = norm_tpu(cfg, params[f"{_norm_key(cfg)}_{top}"], x)
+    last = x[jnp.arange(x.shape[0]), last_token_idx, :]
+    if cfg.tie_embeddings:
+        logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(cfg.dtype))
+    else:
+        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"]["kernel"].astype(cfg.dtype))
+        if "bias" in params.get("lm_head", {}):
+            logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
+    return logits.astype(jnp.float32)
+
+
+REGISTRY.register("v2_embedding", "tpu", embedding_tpu, priority=0)
+REGISTRY.register("v2_norm", "tpu", norm_tpu, priority=0)
+REGISTRY.register("v2_attention", "tpu", attention_tpu, priority=0)
+REGISTRY.register("v2_mlp", "tpu", mlp_tpu, priority=0)
+REGISTRY.register("v2_moe", "tpu", moe_tpu, priority=0)
+REGISTRY.register("v2_unembed", "tpu", unembed_tpu, priority=0)
+
+
+class V2Modules(NamedTuple):
+    """Resolved module bundle (ref ``modules/heuristics.py`` result)."""
+    embedding: Callable
+    norm: Callable
+    attention: Callable
+    mlp: Callable
+    moe: Callable
+    unembed: Callable
+
+
+def build_modules() -> V2Modules:
+    """Resolve the serving modules from the registry (ref
+    ``heuristics.instantiate_*``)."""
+    return V2Modules(embedding=REGISTRY.get("v2_embedding"), norm=REGISTRY.get("v2_norm"),
+                     attention=REGISTRY.get("v2_attention"), mlp=REGISTRY.get("v2_mlp"),
+                     moe=REGISTRY.get("v2_moe"), unembed=REGISTRY.get("v2_unembed"))

@@ -31,17 +31,21 @@ def _resolve(topo):
     return topo if topo is not None else get_mesh_topology(required=False)
 
 
-def _spec(x, dim: int, axis: Optional[str]):
-    parts = [None] * x.ndim
-    parts[dim] = axis
-    return P(*parts)
-
-
 def _constrain(x, dim: int, axis: Optional[str], topo):
-    spec = _spec(x, dim, axis)
+    """Repartition only the token dim; other axes keep their placement
+    (the reference slices/gathers along one dim over the TP group only —
+    clobbering e.g. a data-sharded batch axis would force an all-gather)."""
     if isinstance(x, jax.core.Tracer):
-        return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, spec))
-    return jax.device_put(x, NamedSharding(topo.mesh, spec))
+        parts = [P.UNCONSTRAINED] * x.ndim
+        parts[dim] = axis
+        return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, P(*parts)))
+    # eager: merge with the array's existing spec (UNCONSTRAINED is jit-only)
+    cur = ()
+    if isinstance(getattr(x, "sharding", None), NamedSharding):
+        cur = tuple(x.sharding.spec)
+    parts = list(cur) + [None] * (x.ndim - len(cur))
+    parts[dim] = axis
+    return jax.device_put(x, NamedSharding(topo.mesh, P(*parts)))
 
 
 def drop_tokens(x, dim: int = 1, topo=None):

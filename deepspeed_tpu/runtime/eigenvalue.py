@@ -27,6 +27,9 @@ from ..utils.logging import log_dist
 class Eigenvalue:
     def __init__(self, verbose: bool = False, max_iter: int = 100, tol: float = 1e-2, stability: float = 1e-6,
                  gas_boundary_resolution: int = 1, layer_name: str = "layer_", layer_num: int = 0):
+        if gas_boundary_resolution < 1:
+            raise ValueError(f"gas_boundary_resolution must be >= 1, got {gas_boundary_resolution} "
+                             "(set eigenvalue.enabled=false to disable the pass)")
         self.verbose = verbose
         self.max_iter = max_iter
         self.tol = tol
@@ -54,10 +57,11 @@ class Eigenvalue:
     def _hvp_fn(self, loss_fn, key: str):
         """Compiled HVP for one layer block: (block, v, params, batch, rng)
         -> H_block v. Params/batch/rng are traced arguments so the compiled
-        function stays valid across training steps; ``loss_fn`` must be the
-        same callable across calls (the engine passes its bound loss) — a
-        fresh lambda per call would defeat the cache, not break it."""
-        if key not in self._hvp_cache:
+        function stays valid across training steps; the cache keys on
+        ``(id(loss_fn), key)``, so a different loss gets its own compile and
+        a fresh-but-identical lambda per call merely recompiles."""
+        cache_key = (id(loss_fn), key)
+        if cache_key not in self._hvp_cache:
             import inspect
 
             try:
@@ -74,8 +78,8 @@ class Eigenvalue:
 
                 return jax.jvp(block_grad, (block_params,), (v,))[1]
 
-            self._hvp_cache[key] = jax.jit(hvp)
-        return self._hvp_cache[key]
+            self._hvp_cache[cache_key] = jax.jit(hvp)
+        return self._hvp_cache[cache_key]
 
     @staticmethod
     def _inner(a, b) -> jnp.ndarray:

@@ -222,3 +222,50 @@ class TestEngineV2TP:
         outs = eng.generate(prompts, max_new_tokens=5)
         for p, o in zip(prompts, outs):
             assert o == _dense_generate(model, params, p, 5), f"TP-MoE mismatch for prompt {p}"
+
+
+class TestSwappableModules:
+    """Reference ``v2/modules/interfaces`` + ``heuristics``: serving modules
+    resolve through the kernel registry and can be swapped per-op."""
+
+    def test_default_bundle_resolves(self):
+        from deepspeed_tpu.inference.v2.modules import build_modules
+        from deepspeed_tpu.ops.registry import REGISTRY
+
+        mods = build_modules()
+        for op in ("v2_embedding", "v2_norm", "v2_attention", "v2_mlp", "v2_moe", "v2_unembed"):
+            assert REGISTRY.selected(op) == "tpu"
+        assert callable(mods.mlp) and callable(mods.unembed)
+
+    def test_custom_impl_swaps_in(self, tiny_engine_factory=None):
+        import numpy as np
+
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.inference.v2.modules import mlp_tpu
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.ops.registry import REGISTRY
+
+        calls = []
+
+        def spy_mlp(cfg, p, x):
+            calls.append(x.shape)
+            return mlp_tpu(cfg, p, x)
+
+        REGISTRY.register("v2_mlp", "spy", spy_mlp, priority=0)
+        REGISTRY.set_impl("v2_mlp", "spy")
+        try:
+            import jax
+
+            model = CausalLM(gpt2_tiny())
+            params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+            eng = InferenceEngineV2(
+                model, params,
+                RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
+                                                                            num_kv_blocks=32), dtype="float32"))
+            logits = eng.put([0], [[1, 2, 3]])[0]
+            assert np.isfinite(np.asarray(logits)).all()
+            assert calls, "custom v2_mlp implementation was not dispatched"
+        finally:
+            REGISTRY.set_impl("v2_mlp", None)
+            REGISTRY._ops["v2_mlp"] = [i for i in REGISTRY._ops["v2_mlp"] if i.name != "spy"]
+            REGISTRY._cache.pop("v2_mlp", None)
