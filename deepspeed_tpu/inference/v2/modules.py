@@ -51,8 +51,8 @@ def embedding_tpu(cfg: TransformerConfig, params: Dict[str, Any], input_ids, pos
     x = params["wte"][input_ids].astype(cfg.dtype)
     if cfg.pos_emb == "learned":
         x = x + params["wpe"][positions].astype(cfg.dtype)
-    if cfg.embedding_norm:  # bloom
-        x = norm_tpu(cfg, params[f"{_norm_key(cfg)}_0"], x)
+    if cfg.embedding_norm:  # bloom — honor a swapped v2_norm here too
+        x = REGISTRY.get("v2_norm")(cfg, params[f"{_norm_key(cfg)}_0"], x)
     return x
 
 
@@ -128,7 +128,7 @@ def unembed_tpu(cfg: TransformerConfig, params: Dict[str, Any], x, last_token_id
     """ref ``implementations/unembed/ragged_unembed.py``: final norm +
     last-real-token logits gather + head projection."""
     top = 1 if cfg.embedding_norm else 0
-    x = norm_tpu(cfg, params[f"{_norm_key(cfg)}_{top}"], x)
+    x = REGISTRY.get("v2_norm")(cfg, params[f"{_norm_key(cfg)}_{top}"], x)
     last = x[jnp.arange(x.shape[0]), last_token_idx, :]
     if cfg.tie_embeddings:
         logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(cfg.dtype))

@@ -395,7 +395,9 @@ class CausalLM:
         self.module = Transformer(cfg)
 
     def init(self, rng, example_batch) -> Dict:
-        return self.module.init(rng, example_batch["input_ids"])["params"]
+        from ..utils.init_on_device import on_device_init
+
+        return on_device_init(lambda: self.module.init(rng, example_batch["input_ids"])["params"])()
 
     def apply(self, params, input_ids, **kwargs):
         return self.module.apply({"params": params}, input_ids, **kwargs)

@@ -39,11 +39,23 @@ def _constrain(x, dim: int, axis: Optional[str], topo):
         parts = [P.UNCONSTRAINED] * x.ndim
         parts[dim] = axis
         return jax.lax.with_sharding_constraint(x, NamedSharding(topo.mesh, P(*parts)))
-    # eager: merge with the array's existing spec (UNCONSTRAINED is jit-only)
+    # eager: merge with the array's existing spec (UNCONSTRAINED is jit-only);
+    # only specs from the same mesh transfer, and the target axis is stripped
+    # from every other dim so the result never repeats a mesh axis
     cur = ()
-    if isinstance(getattr(x, "sharding", None), NamedSharding):
-        cur = tuple(x.sharding.spec)
-    parts = list(cur) + [None] * (x.ndim - len(cur))
+    sh = getattr(x, "sharding", None)
+    if isinstance(sh, NamedSharding) and sh.mesh == topo.mesh:
+        cur = tuple(sh.spec)
+
+    def _strip(entry):
+        if entry == axis:
+            return None
+        if isinstance(entry, tuple):
+            kept = tuple(e for e in entry if e != axis)
+            return kept if kept else None
+        return entry
+
+    parts = [_strip(p) for p in cur] + [None] * (x.ndim - len(cur))
     parts[dim] = axis
     return jax.device_put(x, NamedSharding(topo.mesh, P(*parts)))
 

@@ -21,14 +21,18 @@ accumulated in fp32 across chunks and cast to ``w.dtype`` once at the end.
 """
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+_CHUNK_TARGET = int(os.environ.get("DS_TPU_CE_CHUNK", 512))
 
-def _pick_chunk(S: int, target: int = 512) -> int:
-    for c in (target, 256, 128, 64, 32):
+
+def _pick_chunk(S: int, target: Optional[int] = None) -> int:
+    target = target or _CHUNK_TARGET
+    for c in (target, 512, 256, 128, 64, 32):
         if S % c == 0 and c <= S:
             return c
     return S

@@ -15,6 +15,7 @@ no copy until use).
 """
 
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -25,11 +26,19 @@ from ..registry import REGISTRY, pallas_available
 from ._utils import block_that_divides
 
 NEG_INF = -1e30
-DEFAULT_BLOCK = 128
 LANES = 128  # min lane width for fp32 stores (canonical TPU l/m layout)
 
+# Default blocks are large: the grid runs sequentially on the (single)
+# tensor core, and every program pays the VPU online-softmax chain between
+# short MXU ops — many tiny (128,128) programs are latency-bound, not
+# FLOP-bound. (512, 512) keeps the fp32 score block at 1 MB of VMEM,
+# amortizes the chain over 16x more MXU work, and stays causal-efficient
+# at the block boundary. Overridable for autotuning.
+DEFAULT_BQ = int(os.environ.get("DS_TPU_FLASH_BQ", 512))
+DEFAULT_BK = int(os.environ.get("DS_TPU_FLASH_BK", 512))
 
-def _blk(seq: int, want: int = DEFAULT_BLOCK) -> int:
+
+def _blk(seq: int, want: int) -> int:
     return block_that_divides(seq, want)
 
 
@@ -62,6 +71,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q:
         bmax = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, bmax)
         p = jnp.exp(s - new_m[:, None])
+        # fully-masked rows (possible when seq_q > seq_k) have new_m == NEG_INF
+        # and would get p == exp(0) == 1 on masked columns; keep bwd-consistent
         p = jnp.where(s <= NEG_INF, 0.0, p)
         corr = jnp.exp(m - new_m)
         new_l = l * corr + jnp.sum(p, axis=-1)
@@ -82,7 +93,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q:
 def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
-    bq, bk = _blk(Sq), _blk(Sk)
+    bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal)
     o, lse = pl.pallas_call(
         kernel,
@@ -183,7 +194,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
-    bq, bk = _blk(Sq), _blk(Sk)
+    bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (BH, Sq)
     delta = jnp.broadcast_to(delta[..., None], (BH, Sq, LANES))
 

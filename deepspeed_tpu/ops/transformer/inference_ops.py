@@ -260,3 +260,38 @@ def moe_res_matmul(residual: jnp.ndarray, coef: jnp.ndarray, output: jnp.ndarray
 def einsum_sec_sm_ecm(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     """ref ``einsum_sec_sm_ecm_``: the MoE dispatch contraction."""
     return jnp.einsum("sec,sm->ecm", a, b)
+
+
+# ----------------------------------------------------------------------
+# head padding (reference add_padding_ / pad_transform_)
+# ----------------------------------------------------------------------
+def padded_head_size(head_size: int) -> int:
+    """ref ``pt_binding.cpp:1224``: flash kernels want 32/64/128 head dims.
+    Sizes beyond 128 are already lane-aligned multiples — unchanged."""
+    if head_size <= 32:
+        return 32
+    if head_size <= 64:
+        return 64
+    if head_size <= 128:
+        return 128
+    return head_size
+
+
+def add_padding(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray):
+    """ref ``add_padding_``: zero-pad (B, S, H, D) q/k/v head dims to the
+    next flash-friendly size. XLA fuses the pad into the producing GEMM."""
+    D = q.shape[-1]
+    pd = padded_head_size(D)
+    if pd == D:
+        return q, k, v
+    pads = [(0, 0)] * (q.ndim - 1) + [(0, pd - D)]
+    return tuple(jnp.pad(x, pads) for x in (q, k, v))
+
+
+def pad_transform(qkv: jnp.ndarray, heads: int):
+    """ref ``pad_transform_`` (``padd_add_transform``): split a fused
+    (B, S, 3*H*D) QKV tensor into head-padded (B, S, H, pad(D)) q/k/v."""
+    B, S, three_hd = qkv.shape
+    D = three_hd // (3 * heads)
+    q, k, v = jnp.split(qkv.reshape(B, S, 3, heads, D), 3, axis=2)
+    return add_padding(q[:, :, 0], k[:, :, 0], v[:, :, 0])

@@ -60,6 +60,11 @@ class Eigenvalue:
         function stays valid across training steps; the cache keys on
         ``(id(loss_fn), key)``, so a different loss gets its own compile and
         a fresh-but-identical lambda per call merely recompiles."""
+        # keep only the current loss_fn's compiled HVPs: a caller passing a
+        # fresh lambda each boundary recompiles but never grows the cache
+        stale = [k for k in self._hvp_cache if k[0] != id(loss_fn)]
+        for k in stale:
+            del self._hvp_cache[k]
         cache_key = (id(loss_fn), key)
         if cache_key not in self._hvp_cache:
             import inspect

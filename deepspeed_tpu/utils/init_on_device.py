@@ -1,0 +1,98 @@
+"""Abstract ("meta") and device-targeted model construction.
+
+Capability parity: reference ``utils/init_on_device.py`` ``OnDevice`` —
+construct a model's parameters as meta tensors (shape/dtype only, no
+memory) or directly on a target device with a target dtype. The JAX
+analogue: meta = ``jax.eval_shape`` (``ShapeDtypeStruct`` pytree), device
+= ``jax.device_put`` at creation; dtype override maps floating-point
+leaves. ``zero.Init`` (``runtime/zero/init.py``) is the sharded superset;
+OnDevice is the single-device / abstract entry the reference also ships.
+
+Usage::
+
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        params = model.init(rng, batch)        # ShapeDtypeStructs, no HBM
+    with OnDevice(dtype=jnp.bfloat16, device=jax.devices()[0]):
+        params = model.init(rng, batch)        # real, on that device, bf16
+
+``OnDevice.materialize(abstract, init_fn)`` turns a meta tree into real
+params later (the reference's meta-tensor -> checkpoint-load flow).
+"""
+
+import contextlib
+import threading
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+_STATE = threading.local()
+
+
+def _current() -> Optional["OnDevice"]:
+    return getattr(_STATE, "ctx", None)
+
+
+class OnDevice(contextlib.AbstractContextManager):
+    def __init__(self, dtype: Any = None, device: Any = "meta", enabled: bool = True):
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled
+        self._prev = None
+
+    # -- context protocol -------------------------------------------------
+    def __enter__(self):
+        if self.enabled:
+            self._prev = _current()
+            _STATE.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        if self.enabled:
+            _STATE.ctx = self._prev
+        return False
+
+    # -- transformation ---------------------------------------------------
+    def _cast(self, dtype):
+        if self.dtype is not None and jnp.issubdtype(dtype, jnp.floating):
+            return self.dtype
+        return dtype
+
+    def apply(self, init_fn: Callable, *args, **kwargs):
+        """Run ``init_fn`` under this placement policy."""
+        if not self.enabled:
+            return init_fn(*args, **kwargs)
+        if self.device == "meta":
+            shapes = jax.eval_shape(lambda: init_fn(*args, **kwargs))
+            return jax.tree_util.tree_map(
+                lambda s: jax.ShapeDtypeStruct(s.shape, self._cast(s.dtype)), shapes)
+        out = init_fn(*args, **kwargs)
+        out = jax.tree_util.tree_map(lambda x: x.astype(self._cast(x.dtype)), out)
+        return jax.device_put(out, self.device) if self.device is not None else out
+
+    @staticmethod
+    def materialize(abstract, init_fn: Callable, *args, **kwargs):
+        """Meta tree -> real params via ``init_fn`` (checked against the
+        abstract shapes/dtypes — the meta-load contract)."""
+        real = init_fn(*args, **kwargs)
+        flat_a = jax.tree_util.tree_leaves(abstract)
+        flat_r = jax.tree_util.tree_leaves(real)
+        if len(flat_a) != len(flat_r):
+            raise ValueError(f"materialize: leaf count mismatch ({len(flat_a)} abstract vs {len(flat_r)} real)")
+        for a, r in zip(flat_a, flat_r):
+            if tuple(a.shape) != tuple(r.shape):
+                raise ValueError(f"materialize: shape mismatch {a.shape} vs {r.shape}")
+        return jax.tree_util.tree_map(lambda a, r: r.astype(a.dtype), abstract, real)
+
+
+def on_device_init(init_fn: Callable) -> Callable:
+    """Wrap a param-init callable so it honors an enclosing ``OnDevice``
+    context (models call their init through this; see ``CausalLM.init``)."""
+
+    def wrapped(*args, **kwargs):
+        ctx = _current()
+        if ctx is None:
+            return init_fn(*args, **kwargs)
+        return ctx.apply(init_fn, *args, **kwargs)
+
+    return wrapped

@@ -169,3 +169,50 @@ def test_transformer_layer_remat_matches():
     o = T.DeepSpeedTransformerLayer(cfg).apply(params, x)
     o_r = T.DeepSpeedTransformerLayer(cfg_r).apply(params, x)
     np.testing.assert_allclose(np.asarray(o), np.asarray(o_r), rtol=1e-6)
+
+
+def test_head_padding_ops():
+    q, k, v = r(2, 4, 3, 20), r(2, 4, 3, 20), r(2, 4, 3, 20)
+    qp, kp, vp = T.add_padding(q, k, v)
+    assert qp.shape[-1] == 32
+    np.testing.assert_array_equal(np.asarray(qp[..., :20]), np.asarray(q))
+    assert float(jnp.abs(qp[..., 20:]).sum()) == 0.0
+    qkv = r(2, 4, 3 * 3 * 20)
+    q2, k2, v2 = T.pad_transform(qkv, heads=3)
+    assert q2.shape == (2, 4, 3, 32)
+    ref = np.asarray(qkv).reshape(2, 4, 3, 3, 20)
+    np.testing.assert_array_equal(np.asarray(k2[..., :20]), ref[:, :, 1])
+    assert T.padded_head_size(64) == 64 and T.padded_head_size(80) == 128
+
+
+def test_on_device_meta_init():
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.utils.init_on_device import OnDevice
+
+    model = CausalLM(gpt2_tiny())
+    batch = {"input_ids": np.zeros((1, 16), np.int32)}
+    with OnDevice(dtype=jnp.bfloat16, device="meta"):
+        meta = model.init(jax.random.PRNGKey(0), batch)
+    leaves = jax.tree_util.tree_leaves(meta)
+    assert all(isinstance(l, jax.ShapeDtypeStruct) for l in leaves)
+    assert all(l.dtype == jnp.bfloat16 for l in leaves if jnp.issubdtype(l.dtype, jnp.floating))
+    # materialize against the abstract tree
+    real = OnDevice.materialize(meta, lambda: model.init(jax.random.PRNGKey(0), batch))
+    rl = jax.tree_util.tree_leaves(real)
+    assert rl and all(isinstance(l, jax.Array) for l in rl)
+    assert all(a.shape == b.shape and a.dtype == b.dtype for a, b in zip(leaves, rl))
+    # outside the context: normal init
+    normal = model.init(jax.random.PRNGKey(0), batch)
+    assert not isinstance(jax.tree_util.tree_leaves(normal)[0], jax.ShapeDtypeStruct)
+
+
+def test_on_device_dtype_cast_on_device():
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.utils.init_on_device import OnDevice
+
+    model = CausalLM(gpt2_tiny())
+    batch = {"input_ids": np.zeros((1, 16), np.int32)}
+    with OnDevice(dtype=jnp.bfloat16, device=jax.devices()[0]):
+        params = model.init(jax.random.PRNGKey(0), batch)
+    flt = [l for l in jax.tree_util.tree_leaves(params) if jnp.issubdtype(l.dtype, jnp.floating)]
+    assert flt and all(l.dtype == jnp.bfloat16 for l in flt)
