@@ -1,0 +1,3 @@
+from .comm_bench import run_comm_bench
+
+__all__ = ["run_comm_bench"]

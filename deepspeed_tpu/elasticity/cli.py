@@ -1,0 +1,46 @@
+"""Elastic-config solver CLI (``ds_tpu_elastic``).
+
+Capability parity: reference ``bin/ds_elastic`` — read a config with an
+``elasticity`` section and print the solved global batch size, compatible
+chip counts, and per-count micro-batch/grad-accumulation breakdown.
+"""
+
+import argparse
+import json
+from typing import List, Optional
+
+from .elasticity import compute_elastic_config
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser("ds_tpu_elastic", description="solve elastic batch/chip-count compatibility")
+    ap.add_argument("-c", "--config", required=True, help="deepspeed-style JSON config with an elasticity section")
+    ap.add_argument("-w", "--world-size", type=int, default=0, help="current chip count (v0.2 solver)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    with open(args.config) as f:
+        ds_config = json.load(f)
+
+    batch, valid_worlds, micro = compute_elastic_config(ds_config, world_size=args.world_size,
+                                                        return_microbatch=True)
+    candidates = sorted(ds_config["elasticity"].get("micro_batch_sizes", []), reverse=True)
+    rows = []
+    for w in valid_worlds:
+        m = micro
+        if m is None:  # v0.1 without a fixed world: derive per chip count
+            m = next((c for c in candidates if batch % (c * w) == 0), None)
+        gas = batch // (m * w) if m and batch % (m * w) == 0 else None
+        rows.append({"chips": w, "micro_batch": m, "grad_accum": gas, "global_batch": batch})
+
+    if args.json:
+        print(json.dumps({"global_batch": batch, "valid_chip_counts": valid_worlds,
+                          "micro_batch": micro, "plans": rows}))
+        return 0
+    print(f"target global batch: {batch}")
+    print(f"compatible chip counts: {valid_worlds}")
+    print(f"{'chips':>8}{'micro':>8}{'gas':>8}")
+    for r in rows:
+        print(f"{r['chips']:>8}{r['micro_batch'] if r['micro_batch'] else '-':>8}"
+              f"{r['grad_accum'] if r['grad_accum'] else '-':>8}")
+    return 0

@@ -1,0 +1,56 @@
+"""Run a command on every host of a hostfile (``ds_tpu_ssh``).
+
+Capability parity: reference ``bin/ds_ssh`` (a pdsh one-liner over the
+hostfile). Reuses the launcher's hostfile parser and include/exclude
+filters so the host set matches what ``ds_tpu`` would launch on.
+"""
+
+import argparse
+import shlex
+import subprocess
+import sys
+from typing import List, Optional
+
+from .runner import DLTS_HOSTFILE, fetch_hostfile, parse_inclusion_exclusion
+
+
+def build_commands(hosts: List[str], command: str, ssh_options: str = "-o StrictHostKeyChecking=no"):
+    return [["ssh"] + shlex.split(ssh_options) + [host, command] for host in hosts]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser("ds_tpu_ssh", description="run a command on all hosts of a hostfile")
+    ap.add_argument("-f", "--hostfile", default=DLTS_HOSTFILE)
+    ap.add_argument("-i", "--include", default="", help="host filter, ds_tpu syntax (host1@host2)")
+    ap.add_argument("-e", "--exclude", default="")
+    ap.add_argument("--ssh-options", default="-o StrictHostKeyChecking=no")
+    ap.add_argument("--dry-run", action="store_true", help="print the ssh commands without running them")
+    ap.add_argument("command", nargs=argparse.REMAINDER, help="command to run on each host")
+    args = ap.parse_args(argv)
+
+    if not args.command:
+        ap.error("no command given")
+    command = " ".join(args.command)
+
+    resources = fetch_hostfile(args.hostfile)
+    if not resources:
+        print(f"ds_tpu_ssh: no hosts found in {args.hostfile}", file=sys.stderr)
+        return 1
+    resources = parse_inclusion_exclusion(resources, args.include, args.exclude)
+    hosts = list(resources.keys())
+
+    cmds = build_commands(hosts, command, args.ssh_options)
+    if args.dry_run:
+        for c in cmds:
+            print(shlex.join(c))
+        return 0
+
+    procs = [(h, subprocess.Popen(c, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+             for h, c in zip(hosts, cmds)]
+    rc = 0
+    for host, p in procs:
+        out, _ = p.communicate()
+        for line in (out or "").splitlines():
+            print(f"{host}: {line}")
+        rc = rc or p.returncode
+    return rc

@@ -32,10 +32,14 @@ _CHUNK_TARGET = int(os.environ.get("DS_TPU_CE_CHUNK", 512))
 
 def _pick_chunk(S: int, target: Optional[int] = None) -> int:
     target = target or _CHUNK_TARGET
+    if target <= 0:
+        target = 512
+    # fall back only DOWNWARD: a chunk above the requested target would
+    # exceed the (B, C, V) logits-block memory the caller tuned for
     for c in (target, 512, 256, 128, 64, 32):
-        if S % c == 0 and c <= S:
+        if c <= target and S % c == 0 and c <= S:
             return c
-    return S
+    return min(S, target) if S % min(S, target) == 0 else S
 
 
 def _project(xs: jnp.ndarray, w: jnp.ndarray, vd_layout: bool) -> jnp.ndarray:

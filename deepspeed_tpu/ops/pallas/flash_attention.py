@@ -38,8 +38,22 @@ DEFAULT_BQ = int(os.environ.get("DS_TPU_FLASH_BQ", 512))
 DEFAULT_BK = int(os.environ.get("DS_TPU_FLASH_BK", 512))
 
 
+_WARNED: set = set()
+
+
 def _blk(seq: int, want: int) -> int:
-    return block_that_divides(seq, want)
+    if want < 1:
+        want = 512
+    got = block_that_divides(seq, want)
+    if got * 4 < min(want, seq) and (seq, want) not in _WARNED:
+        # e.g. DS_TPU_FLASH_BQ=384 with seq 1024 halves down to 1 — a
+        # per-row grid that is orders of magnitude slower than intended
+        _WARNED.add((seq, want))
+        from ...utils.logging import logger
+
+        logger.warning(f"flash_attention: requested block {want} does not divide seq {seq}; "
+                       f"degraded to {got} — pick a power-of-two block that divides the sequence")
+    return got
 
 
 # ----------------------------------------------------------------------

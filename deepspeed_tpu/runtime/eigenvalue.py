@@ -37,7 +37,8 @@ class Eigenvalue:
         self.gas_boundary_resolution = gas_boundary_resolution
         self.layer_name = layer_name
         self.layer_num = layer_num
-        self._hvp_cache: Dict[str, Callable] = {}
+        self._hvp_cache: Dict[Any, Callable] = {}
+        self._loss_ids: list = []
         log_dist(
             f"enabled eigenvalue with verbose={verbose}, max_iter={max_iter}, tol={tol}, "
             f"stability={stability}, gas_boundary_resolution={gas_boundary_resolution}, "
@@ -60,12 +61,18 @@ class Eigenvalue:
         function stays valid across training steps; the cache keys on
         ``(id(loss_fn), key)``, so a different loss gets its own compile and
         a fresh-but-identical lambda per call merely recompiles."""
-        # keep only the current loss_fn's compiled HVPs: a caller passing a
-        # fresh lambda each boundary recompiles but never grows the cache
-        stale = [k for k in self._hvp_cache if k[0] != id(loss_fn)]
-        for k in stale:
-            del self._hvp_cache[k]
-        cache_key = (id(loss_fn), key)
+        # bound the cache to the last few distinct loss functions: a fresh
+        # lambda per boundary recompiles but never grows the cache, while
+        # callers alternating between a handful of persistent losses keep
+        # all their compiled HVPs warm
+        fid = id(loss_fn)
+        if fid not in self._loss_ids:
+            self._loss_ids.append(fid)
+            if len(self._loss_ids) > 4:
+                evicted = self._loss_ids.pop(0)
+                for k in [k for k in self._hvp_cache if k[0] == evicted]:
+                    del self._hvp_cache[k]
+        cache_key = (fid, key)
         if cache_key not in self._hvp_cache:
             import inspect
 

@@ -17,6 +17,12 @@ Usage::
 
 ``OnDevice.materialize(abstract, init_fn)`` turns a meta tree into real
 params later (the reference's meta-tensor -> checkpoint-load flow).
+
+Scope: the context applies to init entry points wrapped with
+``on_device_init`` — in-tree that is ``CausalLM.init`` (and everything
+built on it, e.g. ``to_pipeline``). For an arbitrary init callable use
+``OnDevice(...).apply(fn, *args)`` directly; a raw ``flax.Module.init``
+called inside the context is NOT intercepted.
 """
 
 import contextlib

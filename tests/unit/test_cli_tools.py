@@ -1,0 +1,94 @@
+"""CLI-surface parity: ds_tpu_bench (comm sweep), ds_tpu_ssh, ds_tpu_elastic
+(reference bin/{ds_bench,ds_ssh,ds_elastic})."""
+
+import json
+import shlex
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.fast
+
+
+def test_comm_bench_sweep_runs():
+    from deepspeed_tpu.benchmarks.comm_bench import format_table, run_comm_bench
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    topo = initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+    res = run_comm_bench(ops=["all_reduce", "all_gather", "all_to_all", "reduce_scatter", "ppermute", "broadcast"],
+                         axis="data", sizes_mb=[0.25], trials=3, warmups=1, topo=topo)
+    assert len(res) == 6
+    for r in res:
+        assert r["world"] == 8 and r["time_us"] > 0 and r["algbw_gbps"] > 0
+    ar = next(r for r in res if r["op"] == "all_reduce")
+    assert ar["busbw_gbps"] == pytest.approx(ar["algbw_gbps"] * 2 * 7 / 8, rel=2e-2)  # values rounded to 3dp
+    table = format_table(res)
+    assert "all_reduce" in table and "busbw" in table
+
+
+def test_comm_bench_cli_json(capsys):
+    from deepspeed_tpu.benchmarks.comm_bench import main
+
+    rc = main(["--ops", "all_reduce", "--sizes-mb", "0.25", "--trials", "2", "--json",
+               "--mesh", '{"data": 8}'])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out and out[0]["op"] == "all_reduce"
+
+
+def test_comm_bench_rejects_trivial_axis():
+    from deepspeed_tpu.benchmarks.comm_bench import run_comm_bench
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    topo = initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+    with pytest.raises(ValueError, match="nothing to benchmark"):
+        run_comm_bench(axis="tensor", topo=topo)
+
+
+def test_ds_ssh_dry_run(tmp_path, capsys):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    hostfile = tmp_path / "hostfile"
+    hostfile.write_text("worker-0 slots=4\nworker-1 slots=4\nworker-2 slots=4\n")
+    rc = main(["-f", str(hostfile), "-e", "worker-2", "--dry-run", "hostname", "-f"])
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    assert all("hostname -f" in l for l in lines)
+    assert not any("worker-2" in l for l in lines)
+
+
+def test_ds_ssh_missing_hostfile(tmp_path, capsys):
+    from deepspeed_tpu.launcher.ds_ssh import main
+
+    rc = main(["-f", str(tmp_path / "nope"), "--dry-run", "true"])
+    assert rc == 1
+
+
+def test_ds_elastic_cli(tmp_path, capsys):
+    from deepspeed_tpu.elasticity.cli import main
+
+    cfg = {
+        "train_batch_size": 2048,
+        "elasticity": {
+            "enabled": True,
+            "max_train_batch_size": 2048,
+            "micro_batch_sizes": [2, 4, 8],
+            "min_gpus": 1,
+            "max_gpus": 64,
+            "min_time": 0,
+            "version": 0.1,
+        },
+    }
+    p = tmp_path / "ds_config.json"
+    p.write_text(json.dumps(cfg))
+    rc = main(["-c", str(p), "--json"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["global_batch"] > 0 and out["valid_chip_counts"]
+    # every compatible chip count gets a full plan (micro x gas x chips == batch)
+    for plan in out["plans"]:
+        assert plan["micro_batch"] in (2, 4, 8)
+        assert plan["micro_batch"] * plan["grad_accum"] * plan["chips"] == out["global_batch"]

@@ -1,7 +1,9 @@
-"""TPU A/B harness (run when the tunnel is healthy): times the model step
-under flash-block / CE-chunk variants. One variant per process:
-  python tmp_tpu_ab.py <BQ> <BK> [CE_CHUNK]
-Prints one line: VARIANT bq=..,bk=..,ce=..: X ms/step (Y tok/s)."""
+"""Single-chip training A/B harness: times the GPT-2-125M fwd+bwd step
+under flash-block / CE-chunk variants. Run one variant per process (the
+env knobs are read at import):
+  python tools/ab_train.py <FLASH_BQ> <FLASH_BK> [CE_CHUNK]
+Optional DS_AB_BS sets the micro-batch (default 16). Prints one line:
+  VARIANT bq=..,bk=..,ce=..,bs=..: X ms/step (Y tok/s)."""
 import os, sys, time
 bq, bk = sys.argv[1], sys.argv[2]
 os.environ["DS_TPU_FLASH_BQ"] = bq
