@@ -59,7 +59,7 @@ class InferenceEngine:
 
     # ------------------------------------------------------------------
     def generate(self, input_ids, max_new_tokens: int = 32, do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, eos_token_id: Optional[int] = None, seed: int = 0, **kwargs):
+                 top_k: int = 0, top_p: float = 1.0, eos_token_id: Optional[int] = None, seed: int = 0, **kwargs):
         """Greedy/sampling decode. Reference ``engine.py:613 _generate``."""
         from .generation import build_step_fns, generate_tokens
 
@@ -70,7 +70,7 @@ class InferenceEngine:
             raise ValueError(f"prompt {S} + max_new_tokens {max_new_tokens} exceeds max_out_tokens {self._max_len}")
         return generate_tokens(self.module, self.params, self._prefill_fn, self._decode_fn, input_ids,
                                max_new_tokens=max_new_tokens, cache_len=self._max_len, cache_dtype=self.dtype,
-                               do_sample=do_sample, temperature=temperature, top_k=top_k,
+                               do_sample=do_sample, temperature=temperature, top_k=top_k, top_p=top_p,
                                eos_token_id=eos_token_id, seed=seed)
 
     def forward(self, input_ids, **kwargs):

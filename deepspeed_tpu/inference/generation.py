@@ -34,19 +34,28 @@ def build_step_fns(model) -> Tuple:
     return jax.jit(prefill, donate_argnums=(2,)), jax.jit(decode_step, donate_argnums=(2,))
 
 
-def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int):
+def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, top_p: float = 1.0):
     if not do_sample or temperature == 0.0:
         return jnp.argmax(logits, axis=-1)
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k > 0:
         vals, _ = jax.lax.top_k(logits, top_k)
         logits = jnp.where(logits < vals[:, -1][:, None], -jnp.inf, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest prefix of descending-prob tokens whose
+        # mass reaches top_p (the first token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # token enters before the mass crossed p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
+        logits = jnp.where(logits < cutoff[:, None], -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
 
 def generate_tokens(model, params, prefill_fn, decode_fn, input_ids, *, max_new_tokens: int, cache_len: int,
                     cache_dtype, do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
-                    eos_token_id: Optional[int] = None, seed: int = 0):
+                    top_p: float = 1.0, eos_token_id: Optional[int] = None, seed: int = 0):
     """Prefill + per-token decode loop; returns (B, S + new) token ids."""
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if input_ids.ndim == 1:
@@ -60,7 +69,7 @@ def generate_tokens(model, params, prefill_fn, decode_fn, input_ids, *, max_new_
     finished = jnp.zeros((B,), bool)
     for i in range(max_new_tokens):
         rng, step_rng = jax.random.split(rng)
-        token = sample_logits(logits, step_rng, do_sample, temperature, top_k)[:, None]
+        token = sample_logits(logits, step_rng, do_sample, temperature, top_k, top_p)[:, None]
         if eos_token_id is not None:
             token = jnp.where(finished[:, None], eos_token_id, token)
             finished = finished | (token[:, 0] == eos_token_id)

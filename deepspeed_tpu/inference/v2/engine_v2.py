@@ -116,6 +116,10 @@ class InferenceEngineV2:
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         run_cfg = dataclasses.replace(cfg, dtype=self.dtype)
+        if run_cfg.sliding_window is not None and run_cfg.sliding_window >= smc.max_context:
+            # the window can never mask inside this engine's context budget;
+            # dropping it keeps decode on the Pallas paged kernel
+            run_cfg = dataclasses.replace(run_cfg, sliding_window=None)
         self.params = jax.tree_util.tree_map(cast, params)
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P

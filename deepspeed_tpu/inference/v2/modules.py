@@ -68,10 +68,11 @@ def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, pos
                   slopes=None, decode_attn: Callable = None):
     """ref ``implementations/attention/dense_blocked_attention.py``: Pallas
     paged decode on the hot path, gather-based reference attention for
-    prefill and for bias-carrying (ALiBi) models."""
-    if decode and slopes is None and decode_attn is not None:
+    prefill and for bias-carrying (ALiBi) or sliding-window models."""
+    if decode and slopes is None and cfg.sliding_window is None and decode_attn is not None:
         return decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
-    return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes)
+    return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes,
+                               window=cfg.sliding_window)
 
 
 def mlp_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):

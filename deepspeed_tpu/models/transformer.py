@@ -50,6 +50,7 @@ class TransformerConfig:
     attn_out_bias: Optional[bool] = None  # override for o_proj only (gpt-j: biased MLP, bias-free attn)
     lm_head_bias: bool = False  # phi / gpt-j carry a bias on the untied head
     embedding_norm: bool = False  # bloom: layernorm directly after the token embedding
+    sliding_window: Optional[int] = None  # mistral: query i attends keys in (i - w, i]
     tie_embeddings: bool = True
     dtype: Any = jnp.float32  # activation/compute dtype
     norm_eps: float = 1e-5
@@ -225,7 +226,8 @@ class Attention(nn.Module):
         bias = None
         if cfg.pos_emb == "alibi":
             bias = alibi_bias(H, k.shape[1])
-        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len, bias=bias)
+        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len, bias=bias,
+                        window=cfg.sliding_window)
         out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.use_attn_out_bias, name="o_proj",
                               dtype=cfg.dtype, param_dtype=jnp.float32)(out)
         return (out, new_cache) if kv_cache is not None else out

@@ -13,10 +13,9 @@ sharding is declarative here.
 
 Supported architectures (the reference's policy-container breadth,
 ``module_inject/containers/`` + ``inference/v2/model_implementations/``):
-``gpt2``, the llama family (``llama``, ``mistral`` — mistral is
-llama-shaped; sliding-window attention is not applied, exact for
-seq_len <= window; ``qwen2``, ``mixtral``), ``opt``, ``gpt_neox``
-(pythia), ``gptj``, ``falcon`` (7b-style), ``phi``, and ``bloom``.
+``gpt2``, the llama family (``llama``, ``mistral`` incl. sliding-window
+attention, ``qwen2``, ``mixtral``), ``opt``, ``gpt_neox`` (pythia),
+``gptj``, ``falcon`` (7b-style), ``phi``, and ``bloom``.
 """
 
 import json
@@ -140,6 +139,13 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         )
         if model_type == "qwen2":
             kw["qkv_bias"] = True
+        if model_type == "mistral" and hf.get("sliding_window"):
+            kw["sliding_window"] = int(hf["sliding_window"])
+        # qwen2 gates its window behind use_sliding_window (and HF further
+        # restricts it to layers >= max_window_layers — all-or-nothing here,
+        # matching HF's behavior for the common max_window_layers=n_layers)
+        if model_type == "qwen2" and hf.get("use_sliding_window") and hf.get("sliding_window"):
+            kw["sliding_window"] = int(hf["sliding_window"])
         if model_type == "mixtral":
             kw.update(
                 moe_num_experts=hf.get("num_local_experts", 8),

@@ -32,11 +32,14 @@ def attention_xla(q: jnp.ndarray,
                   scale: Optional[float] = None,
                   bias: Optional[jnp.ndarray] = None,
                   segment_ids: Optional[jnp.ndarray] = None,
-                  kv_len=None) -> jnp.ndarray:
+                  kv_len=None,
+                  window: Optional[int] = None) -> jnp.ndarray:
     """Multi-head attention, shapes (B, S, H, D) / KV may have fewer heads (GQA).
 
     ``kv_len``: number of valid KV positions (for padded decode caches) —
     queries are placed at absolute positions [kv_len - sq, kv_len).
+    ``window``: sliding-window width (mistral): query i attends keys in
+    (i - window, i].
     Computed in fp32 accumulation regardless of input dtype (softmax
     numerics), returned in the input dtype. XLA fuses the whole block.
     """
@@ -50,7 +53,7 @@ def attention_xla(q: jnp.ndarray,
     if bias is not None:
         logits = logits + bias
     sq, sk = q.shape[1], k.shape[1]
-    if causal or kv_len is not None:
+    if causal or kv_len is not None or window is not None:
         # offset supports decode where q is a suffix of the (valid) kv sequence
         valid = kv_len if kv_len is not None else sk
         offset = valid - sq
@@ -59,6 +62,8 @@ def attention_xla(q: jnp.ndarray,
         mask = ki < valid
         if causal:
             mask = mask & (ki <= qi)
+        if window is not None:
+            mask = mask & (ki > qi - window)
         logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
     if segment_ids is not None:
         seg_q, seg_k = segment_ids if isinstance(segment_ids, tuple) else (segment_ids, segment_ids)

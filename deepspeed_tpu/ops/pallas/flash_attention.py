@@ -288,13 +288,14 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None, bias=None, segment_ids=None,
-                    kv_len=None, interpret: bool = False):
+                    kv_len=None, window=None, interpret: bool = False):
     """Drop-in for ``attention_xla`` on the fast path; falls back to XLA for
-    features the kernel doesn't cover (bias, segments, padded kv)."""
-    if bias is not None or segment_ids is not None or kv_len is not None:
+    features the kernel doesn't cover (bias, segments, padded kv, window)."""
+    if bias is not None or segment_ids is not None or kv_len is not None or window is not None:
         from ..attention import attention_xla
 
-        return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids, kv_len=kv_len)
+        return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids,
+                             kv_len=kv_len, window=window)
     n_rep = q.shape[2] // k.shape[2]
     if n_rep > 1:
         b, s, h, d = k.shape

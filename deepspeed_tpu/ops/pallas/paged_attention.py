@@ -57,13 +57,15 @@ def update_kv_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray, k_new: jnp.ndarr
 # ------------------------------------------------------------------
 def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
                         ctx_lens: jnp.ndarray, q_positions: jnp.ndarray, scale: Optional[float] = None,
-                        alibi_slopes: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                        alibi_slopes: Optional[jnp.ndarray] = None,
+                        window: Optional[int] = None) -> jnp.ndarray:
     """Causal attention of q against paged context.
 
     q: (B, S, H, D); block_tables: (B, P); ctx_lens: (B,) total context
     (incl. the S new tokens); q_positions: (B, S) absolute positions.
     ``alibi_slopes``: optional (H,) per-head slopes — adds the
     shift-invariant ALiBi bias ``slope_h * key_position`` (bloom serving).
+    ``window``: sliding-window width (mistral serving).
     Returns (B, S, H, D).
     """
     B, S, H, D = q.shape
@@ -83,6 +85,8 @@ def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarr
         sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(KVH, G)
         s = s + sl[None, None, :, :, None] * key_pos.astype(jnp.float32)
     valid = (key_pos < ctx_lens[:, None, None, None, None]) & (key_pos <= q_positions[:, :, None, None, None])
+    if window is not None:
+        valid = valid & (key_pos > q_positions[:, :, None, None, None] - window)
     s = jnp.where(valid, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bskgl,blkd->bskgd", p, v.astype(jnp.float32))

@@ -208,3 +208,16 @@ def test_parallel_block_trains(tmp_path):
         engine.step()
         losses.append(float(loss))
     assert losses[-1] < losses[0]
+
+
+def test_mistral_sliding_window_matches(tmp_path):
+    """Sliding-window attention (mistral): seq LONGER than the window must
+    still match the torch oracle."""
+    cfg = transformers.MistralConfig(vocab_size=128, hidden_size=64, intermediate_size=96, num_hidden_layers=2,
+                                     num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                                     sliding_window=4, tie_word_embeddings=False)
+    torch.manual_seed(30)
+    tm = transformers.MistralForCausalLM(cfg).eval()
+    ids = np.random.RandomState(0).randint(0, 128, size=(1, 16))
+    model, params = _roundtrip(tmp_path, tm, ids)
+    assert model.cfg.sliding_window == 4
