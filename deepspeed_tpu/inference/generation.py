@@ -48,6 +48,7 @@ def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, 
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
         keep = cum - probs < top_p  # token enters before the mass crossed p
+        keep = keep.at[:, 0].set(True)  # top-1 always survives (top_p <= 0 == greedy)
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
         logits = jnp.where(logits < cutoff[:, None], -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)

@@ -141,11 +141,20 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             kw["qkv_bias"] = True
         if model_type == "mistral" and hf.get("sliding_window"):
             kw["sliding_window"] = int(hf["sliding_window"])
-        # qwen2 gates its window behind use_sliding_window (and HF further
-        # restricts it to layers >= max_window_layers — all-or-nothing here,
-        # matching HF's behavior for the common max_window_layers=n_layers)
+        # qwen2 gates its window behind use_sliding_window, and HF applies it
+        # only to layers with idx >= max_window_layers; one global window can
+        # express the all-layers (mwl <= 0) and no-layers (mwl >= n_layers)
+        # cases — mixed per-layer configs are rejected rather than mis-served
         if model_type == "qwen2" and hf.get("use_sliding_window") and hf.get("sliding_window"):
-            kw["sliding_window"] = int(hf["sliding_window"])
+            mwl = int(hf.get("max_window_layers", 0))
+            n_layers = kw["n_layers"]
+            if mwl <= 0:
+                kw["sliding_window"] = int(hf["sliding_window"])
+            elif mwl < n_layers:
+                raise NotImplementedError(
+                    f"qwen2 max_window_layers={mwl} windows only a suffix of the {n_layers} layers; "
+                    "per-layer window mixing is unsupported")
+            # mwl >= n_layers: HF uses full attention everywhere — no window
         if model_type == "mixtral":
             kw.update(
                 moe_num_experts=hf.get("num_local_experts", 8),
@@ -216,19 +225,21 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             dtype=dtype,
         )
     elif model_type == "falcon":
-        if hf.get("new_decoder_architecture", False):
-            raise NotImplementedError("falcon new_decoder_architecture (40b/180b ln_attn+ln_mlp) unsupported; "
-                                      "7b-style (parallel_attn + multi_query) is")
+        new_arch = hf.get("new_decoder_architecture", False)
         if not hf.get("parallel_attn", True):
             raise NotImplementedError("falcon with parallel_attn=False unsupported")
-        if not hf.get("multi_query", True):
+        if not new_arch and not hf.get("multi_query", True):
             raise NotImplementedError("falcon multi_query=False uses an interleaved qkv layout (rw-style); "
                                       "unsupported")
+        if new_arch:  # 40b/180b: GQA + separate ln_attn/ln_mlp in parallel
+            n_kv = hf.get("num_kv_heads") or hf.get("num_attention_heads", 8)
+        else:  # 7b: MQA + one shared input layernorm
+            n_kv = 1 if hf.get("multi_query", True) else hf.get("num_attention_heads", 8)
         kw = dict(
             vocab_size=hf["vocab_size"],
             n_layers=hf.get("num_hidden_layers", 2),
             n_heads=hf.get("num_attention_heads", 8),
-            n_kv_heads=1 if hf.get("multi_query", True) else hf.get("num_attention_heads", 8),
+            n_kv_heads=n_kv,
             d_model=hf["hidden_size"],
             d_ff=hf.get("ffn_hidden_size") or 4 * hf["hidden_size"],
             max_seq_len=hf.get("max_position_embeddings", 2048),
@@ -236,7 +247,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             activation=_map_gelu(hf.get("activation", "gelu")),
             pos_emb="alibi" if hf.get("alibi", False) else "rope",
             rope_theta=hf.get("rope_theta", 10000.0),
-            block_type="parallel_shared",
+            block_type="parallel" if new_arch else "parallel_shared",
             dense_bias=hf.get("bias", False),
             tie_embeddings=hf.get("tie_word_embeddings", True),
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
@@ -503,10 +514,16 @@ def convert_gptj(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
 
 
 def convert_falcon(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
-    """HF ``FalconForCausalLM`` (7b-style: parallel_attn + multi-query) ->
-    pytree. Fused qkv rows are [q (H*D), k (KVH*D), v (KVH*D)]."""
+    """HF ``FalconForCausalLM`` -> pytree.
+
+    7b-style (parallel_shared): fused qkv rows are [q (H*D), k (D), v (D)]
+    with one shared input_layernorm. 40b-style (new_decoder_architecture,
+    block_type "parallel"): GQA with per-kv-head grouped qkv rows
+    [(G q) k v] x KVH and separate ln_attn / ln_mlp."""
+    new_arch = cfg.block_type == "parallel"
     sd = _strip_prefix(sd, ("transformer.",))
     H, KVH, D, dm = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_model
+    G = H // KVH
     ln = lambda i: _norm_name(cfg, i)
     params: Dict[str, Any] = {
         "wte": sd["word_embeddings.weight"],
@@ -516,16 +533,36 @@ def convert_falcon(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
         params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
     for i in range(cfg.n_layers):
         p = f"h.{i}."
-        qkv = sd[p + "self_attention.query_key_value.weight"]  # ((H+2*KVH)*D, dm)
-        qw, kw, vw = np.split(qkv, [H * D, (H + KVH) * D], axis=0)
-        layer = {
-            ln(0): {"scale": sd[p + "input_layernorm.weight"], "bias": sd[p + "input_layernorm.bias"]},
-            "attn": {
+        qkv = sd[p + "self_attention.query_key_value.weight"]
+        if new_arch:
+            w = qkv.reshape(KVH, G + 2, D, dm)
+            qw = np.transpose(w[:, :G], (3, 0, 1, 2)).reshape(dm, H, D)
+            kw = np.transpose(w[:, G], (2, 0, 1))  # (dm, KVH, D)
+            vw = np.transpose(w[:, G + 1], (2, 0, 1))
+            attn = {
+                "q_proj": {"kernel": qw},
+                "k_proj": {"kernel": kw},
+                "v_proj": {"kernel": vw},
+                "o_proj": {"kernel": sd[p + "self_attention.dense.weight"].T.reshape(H, D, dm)},
+            }
+            norms = {
+                ln(0): {"scale": sd[p + "ln_attn.weight"], "bias": sd[p + "ln_attn.bias"]},
+                ln(1): {"scale": sd[p + "ln_mlp.weight"], "bias": sd[p + "ln_mlp.bias"]},
+            }
+        else:
+            qw, kw, vw = np.split(qkv, [H * D, (H + KVH) * D], axis=0)
+            attn = {
                 "q_proj": {"kernel": qw.T.reshape(dm, H, D)},
                 "k_proj": {"kernel": kw.T.reshape(dm, KVH, D)},
                 "v_proj": {"kernel": vw.T.reshape(dm, KVH, D)},
                 "o_proj": {"kernel": sd[p + "self_attention.dense.weight"].T.reshape(H, D, dm)},
-            },
+            }
+            norms = {
+                ln(0): {"scale": sd[p + "input_layernorm.weight"], "bias": sd[p + "input_layernorm.bias"]},
+            }
+        layer = {
+            **norms,
+            "attn": attn,
             "mlp": {
                 "up_proj": {"kernel": sd[p + "mlp.dense_h_to_4h.weight"].T},
                 "down_proj": {"kernel": sd[p + "mlp.dense_4h_to_h.weight"].T},
@@ -533,10 +570,15 @@ def convert_falcon(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
         }
         if cfg.use_dense_bias:
             qkv_b = sd[p + "self_attention.query_key_value.bias"]
-            qb, kb, vb = np.split(qkv_b, [H * D, (H + KVH) * D])
-            layer["attn"]["q_proj"]["bias"] = qb.reshape(H, D)
-            layer["attn"]["k_proj"]["bias"] = kb.reshape(KVH, D)
-            layer["attn"]["v_proj"]["bias"] = vb.reshape(KVH, D)
+            if new_arch:
+                b = qkv_b.reshape(KVH, G + 2, D)
+                qb, kb, vb = b[:, :G].reshape(H, D), b[:, G], b[:, G + 1]
+            else:
+                qb, kb, vb = np.split(qkv_b, [H * D, (H + KVH) * D])
+                qb, kb, vb = qb.reshape(H, D), kb.reshape(KVH, D), vb.reshape(KVH, D)
+            layer["attn"]["q_proj"]["bias"] = qb
+            layer["attn"]["k_proj"]["bias"] = kb
+            layer["attn"]["v_proj"]["bias"] = vb
             layer["attn"]["o_proj"]["bias"] = sd[p + "self_attention.dense.bias"]
             layer["mlp"]["up_proj"]["bias"] = sd[p + "mlp.dense_h_to_4h.bias"]
             layer["mlp"]["down_proj"]["bias"] = sd[p + "mlp.dense_4h_to_h.bias"]

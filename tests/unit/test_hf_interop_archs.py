@@ -221,3 +221,14 @@ def test_mistral_sliding_window_matches(tmp_path):
     ids = np.random.RandomState(0).randint(0, 128, size=(1, 16))
     model, params = _roundtrip(tmp_path, tm, ids)
     assert model.cfg.sliding_window == 4
+
+
+def test_falcon_new_decoder_architecture(tmp_path):
+    """Falcon 40b/180b-style: GQA + grouped fused qkv + parallel ln_attn/
+    ln_mlp blocks."""
+    cfg = transformers.FalconConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                                    num_kv_heads=2, new_decoder_architecture=True, parallel_attn=True,
+                                    bias=False, alibi=False, tie_word_embeddings=True)
+    torch.manual_seed(31)
+    model, _ = _roundtrip(tmp_path, transformers.FalconForCausalLM(cfg), IDS)
+    assert model.cfg.block_type == "parallel" and model.cfg.kv_heads == 2

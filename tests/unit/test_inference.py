@@ -144,3 +144,14 @@ def test_top_p_sampling():
     seen_all = {int(sample_logits(logits, jax.random.PRNGKey(i), True, 1.0, 0, top_p=1.0)[0])
                 for i in range(256)}
     assert 2 in seen_all or 3 in seen_all
+
+
+def test_top_p_zero_is_greedy():
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.generation import sample_logits
+
+    logits = jnp.log(jnp.asarray([[0.1, 0.2, 0.6, 0.1]]))
+    for i in range(8):
+        assert int(sample_logits(logits, jax.random.PRNGKey(i), True, 1.0, 0, top_p=0.0)[0]) == 2
