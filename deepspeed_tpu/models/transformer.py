@@ -180,16 +180,9 @@ def alibi_slopes(n_heads: int) -> np.ndarray:
     return np.asarray(slopes(n_heads), np.float32)
 
 
-def alibi_bias(n_heads: int, seq_k: int) -> jnp.ndarray:
-    """Shift-invariant ALiBi bias (1, H, 1, Sk): slope_h * k_position.
-
-    Per query row the full form ``slope * (j - i)`` differs from this by a
-    row-constant, which softmax cancels — so this matches bloom exactly
-    while staying O(H*Sk) instead of O(H*Sq*Sk).
-    """
-    sl = jnp.asarray(alibi_slopes(n_heads))  # (H,)
-    pos = jnp.arange(seq_k, dtype=jnp.float32)
-    return (sl[:, None] * pos[None, :])[None, :, None, :]
+# (the shift-invariant bias form slope_h * key_position lives directly in
+# attention_xla / the flash kernel — per query row it differs from the full
+# slope * (j - i) by a row-constant, which softmax cancels)
 
 
 class Attention(nn.Module):
@@ -223,11 +216,9 @@ class Attention(nn.Module):
             kv_len = cache_len + S
             new_cache = (ck, cv, kv_len)
 
-        bias = None
-        if cfg.pos_emb == "alibi":
-            bias = alibi_bias(H, k.shape[1])
-        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len, bias=bias,
-                        window=cfg.sliding_window)
+        slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
+        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len,
+                        alibi_slopes=slopes, window=cfg.sliding_window)
         out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.use_attn_out_bias, name="o_proj",
                               dtype=cfg.dtype, param_dtype=jnp.float32)(out)
         return (out, new_cache) if kv_cache is not None else out

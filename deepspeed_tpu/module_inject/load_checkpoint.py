@@ -146,7 +146,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         # express the all-layers (mwl <= 0) and no-layers (mwl >= n_layers)
         # cases — mixed per-layer configs are rejected rather than mis-served
         if model_type == "qwen2" and hf.get("use_sliding_window") and hf.get("sliding_window"):
-            mwl = int(hf.get("max_window_layers", 0))
+            mwl = int(hf.get("max_window_layers", 28))  # HF Qwen2Config default
             n_layers = kw["n_layers"]
             if mwl <= 0:
                 kw["sliding_window"] = int(hf["sliding_window"])

@@ -33,13 +33,16 @@ def attention_xla(q: jnp.ndarray,
                   bias: Optional[jnp.ndarray] = None,
                   segment_ids: Optional[jnp.ndarray] = None,
                   kv_len=None,
-                  window: Optional[int] = None) -> jnp.ndarray:
+                  window: Optional[int] = None,
+                  alibi_slopes: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Multi-head attention, shapes (B, S, H, D) / KV may have fewer heads (GQA).
 
     ``kv_len``: number of valid KV positions (for padded decode caches) —
     queries are placed at absolute positions [kv_len - sq, kv_len).
     ``window``: sliding-window width (mistral): query i attends keys in
     (i - window, i].
+    ``alibi_slopes``: (H,) per-head slopes — shift-invariant ALiBi bias
+    ``slope_h * key_position`` (bloom).
     Computed in fp32 accumulation regardless of input dtype (softmax
     numerics), returned in the input dtype. XLA fuses the whole block.
     """
@@ -50,6 +53,12 @@ def attention_xla(q: jnp.ndarray,
     d = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (d**0.5)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if alibi_slopes is not None:
+        # slopes are fixed constants (non-differentiable on every backend —
+        # the Pallas kernel returns a zero cotangent for them too)
+        sl = jax.lax.stop_gradient(jnp.asarray(alibi_slopes, jnp.float32))
+        key_pos = jnp.arange(k.shape[1], dtype=jnp.float32)
+        logits = logits + sl[None, :, None, None] * key_pos[None, None, None, :]
     if bias is not None:
         logits = logits + bias
     sq, sk = q.shape[1], k.shape[1]

@@ -59,11 +59,12 @@ def _blk(seq: int, want: int) -> int:
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int, seq_k: int, scale: float,
-                causal: bool):
+def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int, seq_k: int,
+                scale: float, causal: bool, has_alibi: bool):
     qi = pl.program_id(1)
     q = q_ref[0]  # (bq, D) input dtype — MXU runs bf16 operands w/ fp32 accumulation
     D = q.shape[-1]
+    slope = slopes_ref[0, 0]  # per-head ALiBi slope (0 when disabled)
 
     # queries align to the END of the kv sequence (matches attention_xla)
     offset = seq_k - seq_q
@@ -78,9 +79,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q:
         v = v_ref[0, pl.dslice(j * bk, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if has_alibi:  # shift-invariant ALiBi: slope * key_position
+            s = s + slope * cols.astype(jnp.float32)
         if causal:
             rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         bmax = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, bmax)
@@ -104,11 +107,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q:
     lse_ref[0] = jax.lax.broadcast_in_dim(lse, (lse.shape[0], LANES), (0,))
 
 
-def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
+def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
-    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal)
+    kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
+                               has_alibi=has_alibi)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // bq),
@@ -116,6 +120,7 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
@@ -126,15 +131,17 @@ def _flash_fwd(q, k, v, scale: float, causal: bool, interpret: bool):
             jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, slopes)
     return o, lse
 
 
 # ----------------------------------------------------------------------
 # backward
 # ----------------------------------------------------------------------
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, bk, seq_q, seq_k, scale, causal):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_ref, *, bq, bk, seq_q, seq_k,
+               scale, causal, has_alibi):
     qi = pl.program_id(1)
+    slope = slopes_ref[0, 0]
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
@@ -150,9 +157,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, b
         k = k_ref[0, pl.dslice(j * bk, bk), :]
         v = v_ref[0, pl.dslice(j * bk, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if has_alibi:
+            s = s + slope * cols.astype(jnp.float32)
         if causal:
             rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
@@ -164,9 +173,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *, bq, b
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *, bq, bk, seq_q, seq_k, scale,
-                causal):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_ref, dv_ref, *, bq, bk, seq_q,
+                seq_k, scale, causal, has_alibi):
     kj = pl.program_id(1)
+    slope = slopes_ref[0, 0]
     k = k_ref[0]
     v = v_ref[0]
     D = k.shape[-1]
@@ -185,9 +195,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
         lse = lse_ref[0, pl.dslice(i * bq, bq), 0]
         delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        if has_alibi:
+            s = s + slope * cols.astype(jnp.float32)
         if causal:
             rows = offset + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(cols <= rows, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
@@ -205,7 +217,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool):
+def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
@@ -213,7 +225,8 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool)
     delta = jnp.broadcast_to(delta[..., None], (BH, Sq, LANES))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal),
+        functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
+                          has_alibi=has_alibi),
         grid=(BH, Sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
@@ -222,14 +235,16 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool)
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, slopes)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal),
+        functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
+                          has_alibi=has_alibi),
         grid=(BH, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
@@ -238,6 +253,7 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool)
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, LANES), lambda b, j: (b, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
@@ -248,61 +264,74 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool, interpret: bool)
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, slopes)
     return dq, dk, dv
 
 
 # ----------------------------------------------------------------------
 # public op: (B, S, H, D) layout + GQA + custom_vjp
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash(q, k, v, scale, causal, interpret):
-    o, _ = _flash_core(q, k, v, scale, causal, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, slopes, scale, causal, interpret, has_alibi):
+    o, _ = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi)
     return o
 
 
-def _flash_core(q, k, v, scale, causal, interpret):
+def _bh_slopes(slopes, B, H):
+    """(H,) per-head slopes -> (B*H, LANES) per-program rows."""
+    flat = jnp.tile(jnp.asarray(slopes, jnp.float32), B)  # (B*H,)
+    return jnp.broadcast_to(flat[:, None], (B * H, LANES))
+
+
+def _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
-    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), scale, causal, interpret)
+    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), _bh_slopes(slopes, B, H), scale, causal, interpret,
+                        has_alibi)
     o = o.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     return o, lse
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, interpret):
-    o, lse = _flash_core(q, k, v, scale, causal, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, slopes, scale, causal, interpret, has_alibi):
+    o, lse = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi)
+    return o, (q, k, v, slopes, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, interpret, res, do):
-    q, k, v, o, lse = res
+def _flash_vjp_bwd(scale, causal, interpret, has_alibi, res, do):
+    q, k, v, slopes, o, lse = res
     B, Sq, H, D = q.shape
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
-    dq, dk, dv = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do), scale, causal, interpret)
+    dq, dk, dv = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do),
+                            _bh_slopes(slopes, B, H), scale, causal, interpret, has_alibi)
     back = lambda x, S: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
-    return back(dq, Sq), back(dk, k.shape[1]), back(dv, k.shape[1])
+    return back(dq, Sq), back(dk, k.shape[1]), back(dv, k.shape[1]), jnp.zeros_like(slopes)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None, bias=None, segment_ids=None,
-                    kv_len=None, window=None, interpret: bool = False):
-    """Drop-in for ``attention_xla`` on the fast path; falls back to XLA for
-    features the kernel doesn't cover (bias, segments, padded kv, window)."""
-    if bias is not None or segment_ids is not None or kv_len is not None or window is not None:
+                    kv_len=None, window=None, alibi_slopes=None, interpret: bool = False):
+    """Drop-in for ``attention_xla`` on the fast path; handles ALiBi natively
+    (per-head slope fed to the kernel, shift-invariant form) and falls back
+    to XLA for features the kernel doesn't cover (arbitrary bias, segments,
+    padded kv, window)."""
+    if bias is not None or segment_ids is not None or kv_len is not None or window is not None or (
+            alibi_slopes is not None and not causal):
         from ..attention import attention_xla
 
         return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids,
-                             kv_len=kv_len, window=window)
+                             kv_len=kv_len, window=window, alibi_slopes=alibi_slopes)
     n_rep = q.shape[2] // k.shape[2]
     if n_rep > 1:
         b, s, h, d = k.shape
         k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
         v = jnp.broadcast_to(v[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
     scale = scale if scale is not None else 1.0 / (q.shape[-1]**0.5)
-    return _flash(q, k, v, scale, causal, interpret)
+    has_alibi = alibi_slopes is not None
+    slopes = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else jnp.zeros((q.shape[2],), jnp.float32)
+    return _flash(q, k, v, slopes, scale, causal, interpret, has_alibi)
 
 
 REGISTRY.register("attention", "pallas", flash_attention, is_available=pallas_available, priority=10)
