@@ -46,6 +46,8 @@ def attention_xla(q: jnp.ndarray,
     Computed in fp32 accumulation regardless of input dtype (softmax
     numerics), returned in the input dtype. XLA fuses the whole block.
     """
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1 (got {window}); pass None to disable the sliding window")
     orig_dtype = q.dtype
     n_rep = q.shape[2] // k.shape[2]
     k = _repeat_kv(k, n_rep)

@@ -56,11 +56,29 @@ def _blk(seq: int, want: int) -> int:
     return got
 
 
+
+def _scores(q, k, slope, row0, col0, bq, bk, scale, causal, has_alibi, window):
+    """(bq, bk) fp32 masked scores — the ONE definition of the mask/bias
+    math; fwd and both bwd kernels recompute s through this so they can
+    never drift apart."""
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
+    cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if has_alibi:  # shift-invariant ALiBi: slope * key_position
+        s = s + slope * cols.astype(jnp.float32)
+    if causal:  # window implies causal (non-causal windows fall back to XLA)
+        rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        mask = cols <= rows
+        if window > 0:
+            mask = mask & (cols > rows - window)
+        s = jnp.where(mask, s, NEG_INF)
+    return s
+
+
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
 def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int, seq_k: int,
-                scale: float, causal: bool, has_alibi: bool):
+                scale: float, causal: bool, has_alibi: bool, window: int):
     qi = pl.program_id(1)
     q = q_ref[0]  # (bq, D) input dtype — MXU runs bf16 operands w/ fp32 accumulation
     D = q.shape[-1]
@@ -69,22 +87,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk:
     # queries align to the END of the kv sequence (matches attention_xla)
     offset = seq_k - seq_q
     nk = seq_k // bk
+    j0 = 0
     if causal:
         # last kv block that any row of this q block can see (qi is traced)
         nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), seq_k // bk)
+    if window > 0:
+        # first kv block any row of this q block can see: row r attends
+        # cols in (r - window, r]; the block's min row is offset + qi*bq
+        j0 = jnp.maximum(offset + qi * bq - window + 1, 0) // bk
 
     def body(j, carry):
         acc, m, l = carry
         k = k_ref[0, pl.dslice(j * bk, bk), :]  # (bk, D)
         v = v_ref[0, pl.dslice(j * bk, bk), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # (bq, bk)
-        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        if has_alibi:  # shift-invariant ALiBi: slope * key_position
-            s = s + slope * cols.astype(jnp.float32)
-        if causal:
-            rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window)
         bmax = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, bmax)
         p = jnp.exp(s - new_m[:, None])
@@ -100,19 +116,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk:
     acc0 = jnp.zeros((bq, D), jnp.float32)
     m0 = jnp.full((bq,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk, body, (acc0, m0, l0))
+    acc, m, l = jax.lax.fori_loop(j0, nk, body, (acc0, m0, l0))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse = (m + jnp.log(l_safe)).astype(jnp.float32)
     lse_ref[0] = jax.lax.broadcast_in_dim(lse, (lse.shape[0], LANES), (0,))
 
 
-def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool):
+def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool, window: int):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                               has_alibi=has_alibi)
+                               has_alibi=has_alibi, window=window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // bq),
@@ -139,7 +155,7 @@ def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has
 # backward
 # ----------------------------------------------------------------------
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_ref, *, bq, bk, seq_q, seq_k,
-               scale, causal, has_alibi):
+               scale, causal, has_alibi, window):
     qi = pl.program_id(1)
     slope = slopes_ref[0, 0]
     q = q_ref[0]
@@ -150,31 +166,28 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
 
     offset = seq_k - seq_q
     nk = seq_k // bk
+    j0 = 0
     if causal:
         nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), nk)
+    if window > 0:
+        j0 = jnp.maximum(offset + qi * bq - window + 1, 0) // bk
 
     def body(j, dq):
         k = k_ref[0, pl.dslice(j * bk, bk), :]
         v = v_ref[0, pl.dslice(j * bk, bk), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        cols = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        if has_alibi:
-            s = s + slope * cols.astype(jnp.float32)
-        if causal:
-            rows = offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)  # (bq, bk)
         ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk, body, jnp.zeros((bq, D), jnp.float32))
+    dq = jax.lax.fori_loop(j0, nk, body, jnp.zeros((bq, D), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_ref, dv_ref, *, bq, bk, seq_q,
-                seq_k, scale, causal, has_alibi):
+                seq_k, scale, causal, has_alibi, window):
     kj = pl.program_id(1)
     slope = slopes_ref[0, 0]
     k = k_ref[0]
@@ -187,6 +200,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_
     if causal:
         # first q block that can see this kv block (row offset+r sees col c iff c <= offset+r)
         start = jnp.maximum(kj * bk - offset, 0) // bq
+    nq_end = nq
+    if window > 0:
+        # last q block whose rows still see this kv block: row <= col + window - 1
+        last_row = jnp.minimum((kj + 1) * bk - 1 + window - 1 - offset, seq_q - 1)
+        nq_end = jnp.minimum(last_row // bq + 1, nq)
 
     def body(i, carry):
         dk, dv = carry
@@ -194,13 +212,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_
         do = do_ref[0, pl.dslice(i * bq, bq), :]
         lse = lse_ref[0, pl.dslice(i * bq, bq), 0]
         delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
-        cols = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-        if has_alibi:
-            s = s + slope * cols.astype(jnp.float32)
-        if causal:
-            rows = offset + i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            s = jnp.where(cols <= rows, s, NEG_INF)
+        s = _scores(q, k, slope, offset + i * bq, kj * bk, bq, bk, scale, causal, has_alibi, window)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
         pc = p.astype(do.dtype)
@@ -212,12 +224,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_
 
     dk0 = jnp.zeros((bk, D), jnp.float32)
     dv0 = jnp.zeros((bk, D), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk, dv = jax.lax.fori_loop(start, nq_end, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool):
+def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool,
+               window: int):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
@@ -226,7 +239,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpre
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                          has_alibi=has_alibi),
+                          has_alibi=has_alibi, window=window),
         grid=(BH, Sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
@@ -244,7 +257,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpre
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                          has_alibi=has_alibi),
+                          has_alibi=has_alibi, window=window),
         grid=(BH, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
@@ -271,9 +284,9 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpre
 # ----------------------------------------------------------------------
 # public op: (B, S, H, D) layout + GQA + custom_vjp
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, slopes, scale, causal, interpret, has_alibi):
-    o, _ = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, slopes, scale, causal, interpret, has_alibi, window):
+    o, _ = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi, window)
     return o
 
 
@@ -283,27 +296,27 @@ def _bh_slopes(slopes, B, H):
     return jnp.broadcast_to(flat[:, None], (B * H, LANES))
 
 
-def _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi):
+def _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi, window):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
     o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), _bh_slopes(slopes, B, H), scale, causal, interpret,
-                        has_alibi)
+                        has_alibi, window)
     o = o.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     return o, lse
 
 
-def _flash_vjp_fwd(q, k, v, slopes, scale, causal, interpret, has_alibi):
-    o, lse = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi)
+def _flash_vjp_fwd(q, k, v, slopes, scale, causal, interpret, has_alibi, window):
+    o, lse = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi, window)
     return o, (q, k, v, slopes, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, interpret, has_alibi, res, do):
+def _flash_vjp_bwd(scale, causal, interpret, has_alibi, window, res, do):
     q, k, v, slopes, o, lse = res
     B, Sq, H, D = q.shape
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
     dq, dk, dv = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do),
-                            _bh_slopes(slopes, B, H), scale, causal, interpret, has_alibi)
+                            _bh_slopes(slopes, B, H), scale, causal, interpret, has_alibi, window)
     back = lambda x, S: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
     return back(dq, Sq), back(dk, k.shape[1]), back(dv, k.shape[1]), jnp.zeros_like(slopes)
 
@@ -313,12 +326,12 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None, bias=None, segment_ids=None,
                     kv_len=None, window=None, alibi_slopes=None, interpret: bool = False):
-    """Drop-in for ``attention_xla`` on the fast path; handles ALiBi natively
-    (per-head slope fed to the kernel, shift-invariant form) and falls back
-    to XLA for features the kernel doesn't cover (arbitrary bias, segments,
-    padded kv, window)."""
-    if bias is not None or segment_ids is not None or kv_len is not None or window is not None or (
-            alibi_slopes is not None and not causal):
+    """Drop-in for ``attention_xla`` on the fast path; handles ALiBi and
+    causal sliding windows natively (slope / band mask in-kernel with block
+    skipping) and falls back to XLA for features the kernel doesn't cover
+    (arbitrary bias, segments, padded kv, non-causal windows)."""
+    if bias is not None or segment_ids is not None or kv_len is not None or (
+            alibi_slopes is not None and not causal) or (window is not None and not causal):
         from ..attention import attention_xla
 
         return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids,
@@ -329,9 +342,11 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = No
         k = jnp.broadcast_to(k[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
         v = jnp.broadcast_to(v[:, :, :, None, :], (b, s, h, n_rep, d)).reshape(b, s, h * n_rep, d)
     scale = scale if scale is not None else 1.0 / (q.shape[-1]**0.5)
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1 (got {window}); pass None to disable the sliding window")
     has_alibi = alibi_slopes is not None
     slopes = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else jnp.zeros((q.shape[2],), jnp.float32)
-    return _flash(q, k, v, slopes, scale, causal, interpret, has_alibi)
+    return _flash(q, k, v, slopes, scale, causal, interpret, has_alibi, int(window or 0))
 
 
 REGISTRY.register("attention", "pallas", flash_attention, is_available=pallas_available, priority=10)

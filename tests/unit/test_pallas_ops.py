@@ -405,3 +405,96 @@ class TestFlashAlibi:
         g2 = jax.grad(loss_xla, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-5)
+
+
+class TestFlashWindow:
+    """Native sliding-window (mistral) flash path vs the XLA oracle."""
+
+    @pytest.mark.parametrize("window", [3, 64, 100])
+    def test_fwd_matches_xla(self, window):
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(S=256, H=2, seed=11)
+        o = flash_attention(q, k, v, causal=True, window=window, interpret=True)
+        ref = attention_xla(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+    def test_bwd_matches_xla(self):
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(S=128, H=2, seed=12)
+        g1 = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, window=40,
+                                                      interpret=True).sum(), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: attention_xla(q, k, v, causal=True, window=40).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-5)
+
+    def test_window_with_alibi_composes(self):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = _qkv(S=128, H=4, seed=13)
+        sl = jnp.asarray(alibi_slopes(4))
+        o = flash_attention(q, k, v, causal=True, window=32, alibi_slopes=sl, interpret=True)
+        ref = attention_xla(q, k, v, causal=True, window=32, alibi_slopes=sl)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+
+class TestFlashMultiBlock:
+    """Force small blocks so the j0/nq_end skip arithmetic and multi-block
+    online accumulation actually execute (defaults collapse small seqs to
+    one block)."""
+
+    @pytest.fixture(autouse=True)
+    def small_blocks(self, monkeypatch):
+        import deepspeed_tpu.ops.pallas.flash_attention as fa
+
+        monkeypatch.setattr(fa, "DEFAULT_BQ", 64)
+        monkeypatch.setattr(fa, "DEFAULT_BK", 64)
+
+    @pytest.mark.parametrize("window", [3, 40, 100, None])
+    def test_window_fwd_multiblock(self, window):
+        q, k, v = _qkv(S=256, H=2, seed=21)
+        o = flash_attention(q, k, v, causal=True, window=window, interpret=True)
+        ref = attention_xla(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+    def test_window_bwd_multiblock(self):
+        q, k, v = _qkv(S=256, H=2, seed=22)
+        g1 = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, window=70,
+                                                      interpret=True).sum(), argnums=(0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: attention_xla(q, k, v, causal=True, window=70).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=5e-5)
+
+    def test_window_cross_attention_sq_ne_sk(self):
+        """Suffix queries (chunked prefill) with a window: offset path."""
+        rng = np.random.RandomState(23)
+        q = jnp.asarray(rng.randn(1, 64, 2, 64).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, 256, 2, 64).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, 256, 2, 64).astype(np.float32))
+        o = flash_attention(q, k, v, causal=True, window=48, interpret=True)
+        ref = attention_xla(q, k, v, causal=True, window=48)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+    def test_alibi_multiblock(self):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+
+        q, k, v = _qkv(S=256, H=4, seed=24)
+        sl = jnp.asarray(alibi_slopes(4))
+        o = flash_attention(q, k, v, causal=True, alibi_slopes=sl, interpret=True)
+        ref = attention_xla(q, k, v, causal=True, alibi_slopes=sl)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+
+def test_window_zero_rejected_consistently():
+    q, k, v = _qkv(S=64)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        attention_xla(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match="window must be >= 1"):
+        flash_attention(q, k, v, causal=True, window=0, interpret=True)
