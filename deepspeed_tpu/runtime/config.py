@@ -381,6 +381,12 @@ class DeepSpeedConfig:
         self.pld_config = d.get("progressive_layer_drop", {})
 
         self.gradient_clipping = float(d.get("gradient_clipping", 0.0))
+        # one-dispatch fwd+bwd+optimizer step (engine auto-disables it when
+        # accumulation/compression/offload/eigenvalue interpose)
+        _fs = d.get("fused_step", True)
+        if not isinstance(_fs, bool):
+            raise ValueError(f"fused_step must be a boolean, got {_fs!r}")
+        self.fused_step = _fs
         self.prescale_gradients = bool(d.get("prescale_gradients", False))
         self.gradient_predivide_factor = float(d.get("gradient_predivide_factor", 1.0))
         self.sparse_gradients_enabled = bool(d.get("sparse_gradients", False))

@@ -51,6 +51,11 @@ TRAIN_META_FILENAME = "train_meta.json"
 LATEST_FILENAME = "latest"
 
 
+# marker stored in _cached_grads when the fused one-dispatch step already
+# consumed the gradients inside the forward() call
+_FUSED = object()
+
+
 def _cast_tree(tree, dtype):
     return jax.tree_util.tree_map(lambda x: x.astype(dtype) if hasattr(x, "astype") else x, tree)
 
@@ -347,6 +352,32 @@ class DeepSpeedEngine:
         self._apply_updates = jax.jit(apply_updates, donate_argnums=(0, 1),
                                       out_shardings=(self.param_shardings, self.opt_state_shardings, None, None))
 
+        # one-dispatch fused step: fwd+bwd+optimizer in a single XLA module.
+        # Same math and rng derivation as the split path (XLA can overlap the
+        # optimizer with the backward tail and never materialize the full
+        # fp32 grad tree between dispatches); eligible when every micro-batch
+        # IS a full step and no host-side stage interposes.
+        self._fused_step = None
+        self._fused_pending = None
+        if (self.gradient_accumulation_steps == 1 and comp is None and not use_zeropp
+                and self._host_offload is None and self.eigenvalue is None
+                and self.config.fused_step):
+
+            def fused_step(params32, opt_state, batch, step, scale, inv_scale, lr):
+                rng = jax.random.fold_in(base_rng, step)
+                (_, raw_loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(
+                    params32, batch, rng, scale, None)
+                new_params, new_opt_state, gnorm, overflow = apply_updates(params32, opt_state, grads,
+                                                                           inv_scale, lr)
+                return raw_loss, new_params, new_opt_state, gnorm, overflow
+
+            self._fused_step = jax.jit(
+                fused_step, donate_argnums=(0, 1),
+                out_shardings=(None, self.param_shardings, self.opt_state_shardings, None, None))
+            if self.config.wall_clock_breakdown:
+                log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
+                         "fwd+bwd+optimizer dispatch (backward/step time nothing)", ranks=[0])
+
         def eval_loss(params32, batch, rng):
             params_c = _cast_tree(params32, compute_dtype)
             return loss_fn(params_c, batch, rng)
@@ -410,8 +441,18 @@ class DeepSpeedEngine:
                      and self.micro_steps % self.gradient_accumulation_steps == 0)  # first micro-batch only
         if profiling:
             self._start_flops_profile(batch, self.micro_steps, scale)
-        loss, grads = self._fwd_bwd(self.params, batch, self.micro_steps, scale)
-        self._cached_grads = grads
+        if self._fused_step is not None and not profiling and getattr(self, "_training", True):
+            if self._fused_pending is not None:
+                raise RuntimeError("fused_step: forward() called again before step() consumed the previous one")
+            lr = self._next_lr()
+            inv_scale = 1.0 / self.loss_scaler.loss_scale
+            loss, self.params, self.opt_state, gnorm, overflow = self._fused_step(
+                self.params, self.opt_state, batch, self.micro_steps, scale, inv_scale, lr)
+            self._fused_pending = (gnorm, overflow, lr)
+            self._cached_grads = _FUSED
+        else:
+            loss, grads = self._fwd_bwd(self.params, batch, self.micro_steps, scale)
+            self._cached_grads = grads
         self._last_loss = loss
         if self.eigenvalue is not None:
             self._last_batch = batch  # retained for the gas-boundary eigenvalue pass
@@ -427,7 +468,9 @@ class DeepSpeedEngine:
         if self._cached_grads is None:
             raise RuntimeError("backward() called without a preceding forward()")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        if self._grad_acc is None:
+        if self._cached_grads is _FUSED:
+            pass  # grads were consumed inside the fused forward dispatch
+        elif self._grad_acc is None:
             self._grad_acc = self._cached_grads
         else:
             self._grad_acc = self._accumulate(self._grad_acc, self._cached_grads)
@@ -455,19 +498,24 @@ class DeepSpeedEngine:
             self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
                 self._loss_fn, params_c, self._last_batch,
                 loss_rng=jax.random.fold_in(self._rng, self.global_steps))
-        lr = self._next_lr()
-        # grads were pre-scaled by loss_scale/gas in forward; undo loss_scale
-        # here (the 1/gas factor stays: summed micro-grads become the mean)
-        inv_scale = 1.0 / self.loss_scaler.loss_scale
-        if self._host_offload is not None:
-            new_params, gnorm, overflow = self._host_offload.step(jax.device_get(self._grad_acc), lr,
-                                                                  inv_scale=inv_scale,
-                                                                  grad_clip=self.config.gradient_clipping)
-            if not overflow:
-                self.params = jax.device_put(new_params, self.param_shardings)
+        if self._fused_pending is not None:
+            # params/opt_state were installed by the fused forward dispatch
+            gnorm, overflow, lr = self._fused_pending
+            self._fused_pending = None
         else:
-            self.params, self.opt_state, gnorm, overflow = self._apply_updates(
-                self.params, self.opt_state, self._grad_acc, inv_scale, lr)
+            lr = self._next_lr()
+            # grads were pre-scaled by loss_scale/gas in forward; undo loss_scale
+            # here (the 1/gas factor stays: summed micro-grads become the mean)
+            inv_scale = 1.0 / self.loss_scaler.loss_scale
+            if self._host_offload is not None:
+                new_params, gnorm, overflow = self._host_offload.step(jax.device_get(self._grad_acc), lr,
+                                                                      inv_scale=inv_scale,
+                                                                      grad_clip=self.config.gradient_clipping)
+                if not overflow:
+                    self.params = jax.device_put(new_params, self.param_shardings)
+            else:
+                self.params, self.opt_state, gnorm, overflow = self._apply_updates(
+                    self.params, self.opt_state, self._grad_acc, inv_scale, lr)
         self._grad_acc = None
         self._global_grad_norm = gnorm
         if self.loss_scaler.dynamic or self._host_offload is not None:
@@ -570,6 +618,14 @@ class DeepSpeedEngine:
     def zero_grad(self):
         self._grad_acc = None
         self._cached_grads = None
+        if self._fused_pending is not None:
+            # the fused dispatch already applied the update in-graph; the
+            # step itself cannot be un-applied (buffers were donated), but
+            # discarding here must not wedge the next forward()
+            self._fused_pending = None
+            log_dist("zero_grad: discarding a fused step's bookkeeping — its parameter update was "
+                     "already applied in-graph; set {'fused_step': false} if forward()s must be "
+                     "discardable", ranks=[0])
 
     # ------------------------------------------------------------------
     # introspection (reference engine accessors)

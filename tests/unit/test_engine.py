@@ -154,3 +154,116 @@ def test_fp16_dynamic_loss_scale_runs():
     losses = _train(engine, steps=2)
     assert all(np.isfinite(l) for l in losses)
     assert engine.get_loss_scale() == 2**8  # no overflow at this scale
+
+
+class TestFusedStep:
+    """The one-dispatch fused step must be trajectory-identical to the split
+    fwd_bwd/apply path and guard against forward() re-entry."""
+
+    def _run(self, fused: bool, steps=4):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.parallel.mesh import initialize_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+        model = CausalLM(gpt2_tiny())
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2}, "fused_step": fused})
+        assert (engine._fused_step is not None) == fused
+        rng = np.random.RandomState(0)
+        losses = []
+        for _ in range(steps):
+            b = engine._put_batch({"input_ids": rng.randint(0, 1024, (8, 16)).astype(np.int32)})
+            loss = engine.forward(b)
+            engine.backward(loss)
+            engine.step()
+            losses.append(float(loss))
+        return losses, jax.tree_util.tree_leaves(engine.params)
+
+    def test_trajectory_matches_split_path(self):
+        l_fused, p_fused = self._run(True)
+        l_split, p_split = self._run(False)
+        # same math modulo float reassociation: fusing the optimizer into the
+        # backward module changes XLA's reduction/fusion order
+        np.testing.assert_allclose(l_fused, l_split, rtol=1e-5)
+        for a, b in zip(p_fused, p_split):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5)
+
+    def test_forward_reentry_guarded(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.parallel.mesh import initialize_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+        model = CausalLM(gpt2_tiny())
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 0}})
+        b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
+        engine.forward(b)
+        with pytest.raises(RuntimeError, match="fused_step"):
+            engine.forward(b)
+
+    def test_gas_gt_1_uses_split_path(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.parallel.mesh import initialize_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+        model = CausalLM(gpt2_tiny())
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 0}})
+        assert engine._fused_step is None
+
+    def test_eval_mode_bypasses_fused(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.parallel.mesh import initialize_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+        model = CausalLM(gpt2_tiny())
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-1}},
+                    "zero_optimization": {"stage": 0}})
+        b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
+        engine.eval()
+        before = np.asarray(jax.tree_util.tree_leaves(engine.params)[0]).copy()
+        engine.forward(b)
+        engine.forward(b)  # no re-entry error in eval mode
+        after = np.asarray(jax.tree_util.tree_leaves(engine.params)[0])
+        np.testing.assert_array_equal(before, after)  # no optimizer side effects
+
+    def test_zero_grad_unwedges_fused(self):
+        import deepspeed_tpu
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.parallel.mesh import initialize_mesh
+        from deepspeed_tpu.runtime.config import MeshConfig
+
+        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+        model = CausalLM(gpt2_tiny())
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 0}})
+        b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
+        engine.forward(b)
+        engine.zero_grad()
+        loss = engine.forward(b)  # must not raise
+        engine.backward(loss)
+        engine.step()
