@@ -13,9 +13,10 @@ sharding is declarative here.
 
 Supported architectures (the reference's policy-container breadth,
 ``module_inject/containers/`` + ``inference/v2/model_implementations/``):
-``gpt2``, the llama family (``llama``, ``mistral`` incl. sliding-window
-attention, ``qwen2``, ``mixtral``), ``opt``, ``gpt_neox`` (pythia),
-``gptj``, ``falcon`` (7b-style), ``phi``, and ``bloom``.
+``gpt2``, the llama family (``llama``, ``mistral``/``mixtral`` incl.
+sliding-window attention, ``qwen2``), ``opt``, ``gpt_neox`` (pythia),
+``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``, and
+``gpt_bigcode`` (starcoder).
 """
 
 import json
@@ -139,7 +140,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         )
         if model_type == "qwen2":
             kw["qkv_bias"] = True
-        if model_type == "mistral" and hf.get("sliding_window"):
+        if model_type in ("mistral", "mixtral") and hf.get("sliding_window"):
             kw["sliding_window"] = int(hf["sliding_window"])
         # qwen2 gates its window behind use_sliding_window, and HF applies it
         # only to layers with idx >= max_window_layers; one global window can
@@ -273,6 +274,22 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             lm_head_bias=True,
             tie_embeddings=hf.get("tie_word_embeddings", False),
             norm_eps=hf.get("layer_norm_eps", 1e-5),
+            dtype=dtype,
+        )
+    elif model_type == "gpt_bigcode":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("n_layer", 12),
+            n_heads=hf.get("n_head", 12),
+            n_kv_heads=1 if hf.get("multi_query", True) else hf.get("n_head", 12),
+            d_model=hf["n_embd"],
+            d_ff=hf.get("n_inner") or 4 * hf["n_embd"],
+            max_seq_len=hf.get("n_positions", 2048),
+            norm="layernorm",
+            activation=_map_gelu(hf.get("activation_function", "gelu_pytorch_tanh")),
+            pos_emb="learned",
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             dtype=dtype,
         )
     elif model_type == "bloom":
@@ -624,6 +641,50 @@ def convert_phi(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     return params
 
 
+def convert_gpt_bigcode(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``GPTBigCodeForCausalLM`` (StarCoder) -> pytree: learned positions,
+    MQA with contiguous [q (H*D), k (KVH*D), v (KVH*D)] fused rows stored in
+    torch Linear (out, in) layout."""
+    sd = _strip_prefix(sd, ("transformer.",))
+    H, KVH, D, dm = cfg.n_heads, cfg.kv_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["wte.weight"],
+        "wpe": sd["wpe.weight"][:cfg.max_seq_len],
+        ln(0): {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        qkv_w = sd[p + "attn.c_attn.weight"]
+        qkv_b = sd[p + "attn.c_attn.bias"]
+        if KVH == H:  # MHA variant: per-head interleaved [q_h k_h v_h] rows
+            w3 = qkv_w.reshape(H, 3, D, dm)
+            b3 = qkv_b.reshape(H, 3, D)
+            qw, kw_, vw = (w3[:, j].reshape(H * D, dm) for j in range(3))
+            qb, kb, vb = (b3[:, j].reshape(H * D) for j in range(3))
+        else:  # MQA: contiguous [q (H*D), k (KVH*D), v (KVH*D)]
+            qw, kw_, vw = np.split(qkv_w, [H * D, (H + KVH) * D], axis=0)
+            qb, kb, vb = np.split(qkv_b, [H * D, (H + KVH) * D])
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+            ln(1): {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
+            "attn": {
+                "q_proj": {"kernel": qw.T.reshape(dm, H, D), "bias": qb.reshape(H, D)},
+                "k_proj": {"kernel": kw_.T.reshape(dm, KVH, D), "bias": kb.reshape(KVH, D)},
+                "v_proj": {"kernel": vw.T.reshape(dm, KVH, D), "bias": vb.reshape(KVH, D)},
+                "o_proj": {"kernel": sd[p + "attn.c_proj.weight"].T.reshape(H, D, dm),
+                           "bias": sd[p + "attn.c_proj.bias"]},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.c_fc.weight"].T, "bias": sd[p + "mlp.c_fc.bias"]},
+                "down_proj": {"kernel": sd[p + "mlp.c_proj.weight"].T, "bias": sd[p + "mlp.c_proj.bias"]},
+            },
+        }
+    return params
+
+
 def convert_bloom(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     """HF ``BloomForCausalLM`` -> pytree: ALiBi attention, embedding
     layernorm, per-head-interleaved fused qkv (H, 3, D)."""
@@ -667,6 +728,7 @@ _CONVERTERS = {
     "falcon": convert_falcon,
     "phi": convert_phi,
     "bloom": convert_bloom,
+    "gpt_bigcode": convert_gpt_bigcode,
 }
 
 

@@ -376,7 +376,7 @@ class DeepSpeedEngine:
                 out_shardings=(None, self.param_shardings, self.opt_state_shardings, None, None))
             if self.config.wall_clock_breakdown:
                 log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
-                         "fwd+bwd+optimizer dispatch (backward/step time nothing)", ranks=[0])
+                         "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
 
         def eval_loss(params32, batch, rng):
             params_c = _cast_tree(params32, compute_dtype)
@@ -426,6 +426,10 @@ class DeepSpeedEngine:
         return out
 
     def forward(self, batch):
+        if self._fused_pending is not None and getattr(self, "_training", True):
+            # raised BEFORE the timer starts: a caught-and-retried error must
+            # not leave the forward timer running across the exception
+            raise RuntimeError("fused_step: forward() called again before step() consumed the previous one")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         if self.curriculum_scheduler is not None:
             batch = self._apply_curriculum(batch)
@@ -442,8 +446,6 @@ class DeepSpeedEngine:
         if profiling:
             self._start_flops_profile(batch, self.micro_steps, scale)
         if self._fused_step is not None and not profiling and getattr(self, "_training", True):
-            if self._fused_pending is not None:
-                raise RuntimeError("fused_step: forward() called again before step() consumed the previous one")
             lr = self._next_lr()
             inv_scale = 1.0 / self.loss_scaler.loss_scale
             loss, self.params, self.opt_state, gnorm, overflow = self._fused_step(
@@ -616,16 +618,16 @@ class DeepSpeedEngine:
         return self._eval_loss(self.params, batch, rng)
 
     def zero_grad(self):
+        if self._fused_pending is not None:
+            # the fused dispatch already applied the update in-graph (params
+            # donated — there is nothing to roll back), and silently dropping
+            # the bookkeeping would drift the lr schedule and loss scaler
+            raise RuntimeError(
+                "zero_grad: a fused step is pending — fused mode makes forward()+step() atomic, so a "
+                "forward() cannot be discarded. Call step() to commit it, or set {'fused_step': false} "
+                "if your loop needs discardable forwards")
         self._grad_acc = None
         self._cached_grads = None
-        if self._fused_pending is not None:
-            # the fused dispatch already applied the update in-graph; the
-            # step itself cannot be un-applied (buffers were donated), but
-            # discarding here must not wedge the next forward()
-            self._fused_pending = None
-            log_dist("zero_grad: discarding a fused step's bookkeeping — its parameter update was "
-                     "already applied in-graph; set {'fused_step': false} if forward()s must be "
-                     "discardable", ranks=[0])
 
     # ------------------------------------------------------------------
     # introspection (reference engine accessors)
@@ -691,8 +693,15 @@ class DeepSpeedEngine:
     def _ckpt_dir(self, save_dir: str, tag: str) -> str:
         return os.path.join(save_dir, str(tag))
 
+    def _check_no_pending_fused(self, what: str):
+        if self._fused_pending is not None:
+            raise RuntimeError(f"{what}: a fused step is pending — its parameter update is already applied "
+                               "but global_steps/scheduler state are not; call step() first (resuming a "
+                               "checkpoint taken here would double-apply the update)")
+
     def save_checkpoint(self, save_dir: str, tag=None, client_state: Optional[Dict] = None, save_latest: bool = True,
                         exclude_frozen_parameters: bool = False):
+        self._check_no_pending_fused("save_checkpoint")
         tag = str(tag) if tag is not None else f"global_step{self.global_steps}"
         d = self._ckpt_dir(save_dir, tag)
         self.checkpoint_engine.makedirs(d)
@@ -792,6 +801,7 @@ class DeepSpeedEngine:
     def save_universal_checkpoint(self, save_dir: str, tag=None):
         """Write the degree-independent universal layout directly
         (reference needs offline ``ds_to_universal.py`` for this)."""
+        self._check_no_pending_fused("save_universal_checkpoint")
         from ..checkpoint.universal import save_universal_checkpoint
 
         return save_universal_checkpoint(self, save_dir, tag)

@@ -65,6 +65,7 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
         """Current weights in inference layout; cached until the next
         optimizer step invalidates them (reference: containers re-populated
         per generate phase, :306)."""
+        self._check_no_pending_fused("hybrid generate")  # params/step counter must agree
         if self._gen_params is not None and self._gen_at_step == self.global_steps:
             return self._gen_params
         from ..linear import fuse_lora_tree

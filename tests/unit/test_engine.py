@@ -157,22 +157,11 @@ def test_fp16_dynamic_loss_scale_runs():
 
 
 class TestFusedStep:
-    """The one-dispatch fused step must be trajectory-identical to the split
-    fwd_bwd/apply path and guard against forward() re-entry."""
+    """The one-dispatch fused step must match the split fwd_bwd/apply path
+    and make forward()+step() atomic (no discard, no torn state)."""
 
     def _run(self, fused: bool, steps=4):
-        import deepspeed_tpu
-        from deepspeed_tpu.models import CausalLM, gpt2_tiny
-        from deepspeed_tpu.parallel.mesh import initialize_mesh
-        from deepspeed_tpu.runtime.config import MeshConfig
-
-        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
-        model = CausalLM(gpt2_tiny())
-        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=model, model_parameters=params,
-            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                    "zero_optimization": {"stage": 2}, "fused_step": fused})
+        engine = _make_engine(stage=2, extra={"gradient_accumulation_steps": 1, "fused_step": fused})
         assert (engine._fused_step is not None) == fused
         rng = np.random.RandomState(0)
         losses = []
@@ -194,52 +183,18 @@ class TestFusedStep:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=5e-5)
 
     def test_forward_reentry_guarded(self):
-        import deepspeed_tpu
-        from deepspeed_tpu.models import CausalLM, gpt2_tiny
-        from deepspeed_tpu.parallel.mesh import initialize_mesh
-        from deepspeed_tpu.runtime.config import MeshConfig
-
-        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
-        model = CausalLM(gpt2_tiny())
-        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=model, model_parameters=params,
-            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                    "zero_optimization": {"stage": 0}})
+        engine = _make_engine(stage=0, extra={"gradient_accumulation_steps": 1})
         b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
         engine.forward(b)
         with pytest.raises(RuntimeError, match="fused_step"):
             engine.forward(b)
 
     def test_gas_gt_1_uses_split_path(self):
-        import deepspeed_tpu
-        from deepspeed_tpu.models import CausalLM, gpt2_tiny
-        from deepspeed_tpu.parallel.mesh import initialize_mesh
-        from deepspeed_tpu.runtime.config import MeshConfig
-
-        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
-        model = CausalLM(gpt2_tiny())
-        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=model, model_parameters=params,
-            config={"train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 2,
-                    "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                    "zero_optimization": {"stage": 0}})
+        engine = _make_engine(stage=0)  # helper default gas=2
         assert engine._fused_step is None
 
     def test_eval_mode_bypasses_fused(self):
-        import deepspeed_tpu
-        from deepspeed_tpu.models import CausalLM, gpt2_tiny
-        from deepspeed_tpu.parallel.mesh import initialize_mesh
-        from deepspeed_tpu.runtime.config import MeshConfig
-
-        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
-        model = CausalLM(gpt2_tiny())
-        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=model, model_parameters=params,
-            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-1}},
-                    "zero_optimization": {"stage": 0}})
+        engine = _make_engine(stage=0, extra={"gradient_accumulation_steps": 1}, lr=1e-1)
         b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
         engine.eval()
         before = np.asarray(jax.tree_util.tree_leaves(engine.params)[0]).copy()
@@ -248,22 +203,21 @@ class TestFusedStep:
         after = np.asarray(jax.tree_util.tree_leaves(engine.params)[0])
         np.testing.assert_array_equal(before, after)  # no optimizer side effects
 
-    def test_zero_grad_unwedges_fused(self):
-        import deepspeed_tpu
-        from deepspeed_tpu.models import CausalLM, gpt2_tiny
-        from deepspeed_tpu.parallel.mesh import initialize_mesh
-        from deepspeed_tpu.runtime.config import MeshConfig
-
-        initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
-        model = CausalLM(gpt2_tiny())
-        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
-        engine, _, _, _ = deepspeed_tpu.initialize(
-            model=model, model_parameters=params,
-            config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                    "zero_optimization": {"stage": 0}})
+    def test_discard_and_midstep_save_rejected(self):
+        """fused forward+step is atomic: zero_grad and save_checkpoint in the
+        window must raise instead of drifting the lr schedule / writing a
+        checkpoint that would double-apply on resume."""
+        engine = _make_engine(stage=0, extra={"gradient_accumulation_steps": 1})
         b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
         engine.forward(b)
+        with pytest.raises(RuntimeError, match="fused"):
+            engine.zero_grad()
+        with pytest.raises(RuntimeError, match="fused"):
+            engine.save_checkpoint("/tmp/nope")
+        # consuming the step restores every path
+        engine.backward(engine._last_loss)
+        engine.step()
         engine.zero_grad()
-        loss = engine.forward(b)  # must not raise
+        loss = engine.forward(b)
         engine.backward(loss)
         engine.step()

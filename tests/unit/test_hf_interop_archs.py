@@ -232,3 +232,13 @@ def test_falcon_new_decoder_architecture(tmp_path):
     torch.manual_seed(31)
     model, _ = _roundtrip(tmp_path, transformers.FalconForCausalLM(cfg), IDS)
     assert model.cfg.block_type == "parallel" and model.cfg.kv_heads == 2
+
+
+@pytest.mark.parametrize("mq", [True, False])
+def test_gpt_bigcode_logits_match(tmp_path, mq):
+    """StarCoder family: MQA (and MHA variant) with learned positions."""
+    cfg = transformers.GPTBigCodeConfig(vocab_size=128, n_positions=64, n_embd=64, n_layer=2, n_head=4,
+                                        multi_query=mq)
+    torch.manual_seed(40)
+    model, _ = _roundtrip(tmp_path / str(mq), transformers.GPTBigCodeForCausalLM(cfg), IDS)
+    assert model.cfg.kv_heads == (1 if mq else 4) and model.cfg.pos_emb == "learned"
