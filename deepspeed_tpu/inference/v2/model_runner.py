@@ -60,14 +60,21 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
 
     if mesh is not None and tp > 1:
         # heads split over `tensor`: each shard decodes its own heads
-        # against its KV-page shard (ref v2 sharding helpers)
+        # against its KV-page shard (ref v2 sharding helpers). Per-shard
+        # slope slices aren't expressible as a baked constant, so ALiBi/
+        # window models route through the gather path under TP.
         decode_attn = shard_map(
             functools.partial(paged_attention_decode, interpret=interpret),
             mesh=mesh, in_specs=(P(None, "tensor", None), P(None, None, "tensor", None),
                                  P(None, None, "tensor", None), P(None, None), P(None)),
             out_specs=P(None, "tensor", None), check_vma=False)
+        decode_native = False
     else:
-        decode_attn = functools.partial(paged_attention_decode, interpret=interpret)
+        decode_attn = functools.partial(
+            paged_attention_decode, interpret=interpret,
+            alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
+            window=cfg.sliding_window)
+        decode_native = True
 
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids, positions)
@@ -75,9 +82,9 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     cos = sin = None
     if cfg.pos_emb == "rope":
         cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
-    # ALiBi decode goes through the gather-based attention path: the Pallas
-    # decode kernel carries no bias lanes (same stance as flash_attention's
-    # bias fallback)
+    # slopes feed the gather-based attention used for prefill and for the
+    # TP-sharded decode; the single-chip decode kernel has them baked in
+    # (decode_native above)
     slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
 
     for i in range(cfg.n_layers):
@@ -96,7 +103,7 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         v_pages = v_pages.at[i].set(vp)
 
         attn = mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
-                              slopes=slopes, decode_attn=decode_attn)
+                              slopes=slopes, decode_attn=decode_attn, decode_native=decode_native)
         attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
 
         if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j

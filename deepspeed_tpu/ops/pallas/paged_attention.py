@@ -96,8 +96,9 @@ def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarr
 # ------------------------------------------------------------------
 # Pallas decode kernel
 # ------------------------------------------------------------------
-def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *, bs: int,
-                   kvh: int, g: int, d: int, pages: int, scale: float):
+def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref, acc_ref, m_ref, l_ref,
+                   *, bs: int, kvh: int, g: int, d: int, pages: int, scale: float, has_alibi: bool = False,
+                   window: int = 0):
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -109,15 +110,24 @@ def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref, a
 
     ctx = ctx_lens_ref[b]
     start = p * bs
+    live = start < ctx
+    if window > 0:  # query sits at ctx-1: pages fully before the band skip
+        live = live & (start + bs > ctx - window)
 
-    @pl.when(start < ctx)
+    @pl.when(live)
     def _compute():
         q = q_ref[0].reshape(kvh, g, d).astype(jnp.float32) * scale
         k = k_ref[0].astype(jnp.float32)  # (bs, kvh, d)
         v = v_ref[0].astype(jnp.float32)
         s = jnp.einsum("kgd,tkd->kgt", q, k, preferred_element_type=jnp.float32)
         pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
-        s = jnp.where(pos < ctx, s, NEG_INF)
+        if has_alibi:
+            sl = slopes_ref[:, 0].reshape(kvh, g)[..., None]
+            s = s + sl * pos.astype(jnp.float32)
+        valid = pos < ctx
+        if window > 0:
+            valid = valid & (pos > ctx - 1 - window)
+        s = jnp.where(valid, s, NEG_INF)
 
         m_prev = m_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
@@ -136,24 +146,32 @@ def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref, a
 
 def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
                            ctx_lens: jnp.ndarray, scale: Optional[float] = None,
-                           interpret: bool = False) -> jnp.ndarray:
+                           interpret: bool = False, alibi_slopes=None,
+                           window: Optional[int] = None) -> jnp.ndarray:
     """One-token-per-sequence paged attention.
 
     q: (B, H, D); k_pages/v_pages: (N, bs, KVH, D); block_tables: (B, P);
-    ctx_lens: (B,). Returns (B, H, D). Rows with ctx_len == 0 (padding)
-    produce unspecified output.
+    ctx_lens: (B,). ``alibi_slopes``: static per-head slopes (bloom);
+    ``window``: static sliding-window width (mistral) — both are baked into
+    the kernel at trace time. Returns (B, H, D). Rows with ctx_len == 0
+    (padding) produce unspecified output.
     """
     B, H, D = q.shape
     N, bs, KVH, _ = k_pages.shape
     P = block_tables.shape[1]
     G = H // KVH
     scale = scale if scale is not None else D**-0.5
+    has_alibi = alibi_slopes is not None
 
     if pltpu is None:  # pallas TPU submodule absent: gather path covers interpret mode too
+        sl = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else None
         return paged_attention_ref(q[:, None], k_pages, v_pages, block_tables, ctx_lens,
-                                   (ctx_lens - 1)[:, None], scale)[:, 0]
+                                   (ctx_lens - 1)[:, None], scale, alibi_slopes=sl, window=window)[:, 0]
 
-    kernel = functools.partial(_decode_kernel, bs=bs, kvh=KVH, g=G, d=D, pages=P, scale=scale)
+    slopes_in = (jnp.broadcast_to(jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1), (H, 128))
+                 if has_alibi else jnp.zeros((H, 128), jnp.float32))
+    kernel = functools.partial(_decode_kernel, bs=bs, kvh=KVH, g=G, d=D, pages=P, scale=scale,
+                               has_alibi=has_alibi, window=int(window or 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, P),
@@ -161,6 +179,7 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
             pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)),
             pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0)),
             pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0)),
+            pl.BlockSpec((H, 128), lambda b, p, bt, cl: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)),
         scratch_shapes=[
@@ -176,4 +195,4 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
         interpret=interpret,
         compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("parallel", "arbitrary")) if not interpret and
         hasattr(pltpu, "TPUCompilerParams") else None,
-    )(block_tables, ctx_lens, q, k_pages, v_pages)
+    )(block_tables, ctx_lens, q, k_pages, v_pages, slopes_in)

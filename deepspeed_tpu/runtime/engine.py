@@ -738,6 +738,14 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag=None, load_module_strict: bool = True,
                         load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
+        if self._fused_pending is not None:
+            # the load wholly replaces params/opt_state/schedule — drop the
+            # pending fused step's bookkeeping rather than committing it onto
+            # (or spuriously blocking) the freshly loaded state
+            self._fused_pending = None
+            self._cached_grads = None
+            log_dist("load_checkpoint: discarding a pending fused step — its state is being overwritten",
+                     ranks=[0])
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILENAME)
             if not os.path.exists(latest):

@@ -269,3 +269,32 @@ class TestSwappableModules:
             REGISTRY.set_impl("v2_mlp", None)
             REGISTRY._ops["v2_mlp"] = [i for i in REGISTRY._ops["v2_mlp"] if i.name != "spy"]
             REGISTRY._cache.pop("v2_mlp", None)
+
+
+class TestDecodeKernelBiasFeatures:
+    """ALiBi / sliding-window baked into the Pallas decode kernel vs the
+    gather-based reference path."""
+
+    def _setup(self, B=3, H=4, KVH=2, D=64, bs=8, P=6):
+        rng = np.random.RandomState(0)
+        n_pages = B * P + 2
+        q = jnp.asarray(rng.randn(B, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, bs, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, bs, KVH, D), jnp.float32)
+        tables = jnp.asarray(rng.permutation(n_pages)[:B * P].reshape(B, P), jnp.int32)
+        ctx = jnp.asarray([5, 17, 40], jnp.int32)
+        return q, kp, vp, tables, ctx
+
+    @pytest.mark.parametrize("feature", ["alibi", "window", "both"])
+    def test_matches_gather_reference(self, feature):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+        from deepspeed_tpu.ops.pallas.paged_attention import paged_attention_decode, paged_attention_ref
+
+        q, kp, vp, tables, ctx = self._setup()
+        sl = alibi_slopes(4) if feature in ("alibi", "both") else None
+        win = 9 if feature in ("window", "both") else None
+        out = paged_attention_decode(q, kp, vp, tables, ctx, interpret=True, alibi_slopes=sl, window=win)
+        slj = jnp.asarray(sl) if sl is not None else None
+        ref = paged_attention_ref(q[:, None], kp, vp, tables, ctx, (ctx - 1)[:, None],
+                                  alibi_slopes=slj, window=win)[:, 0]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=2e-5)
