@@ -103,7 +103,42 @@ def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
     return batch * (new_tokens - half) / decode_dt
 
 
+def _probe_backend(timeout_s: float = 180.0):
+    """Initialize the jax backend under a watchdog: a wedged TPU tunnel makes
+    the first device query hang forever — exit loudly instead of hanging the
+    driver (the stuck init thread cannot be cancelled, hence os._exit)."""
+    import threading
+
+    result = {}
+
+    def probe():
+        try:
+            import jax
+
+            result["n"] = jax.device_count()
+            result["platform"] = jax.devices()[0].platform
+        except BaseException as e:  # noqa: BLE001 - surfaced on the main thread
+            result["err"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "err" in result:
+        raise result["err"]  # a real init failure, not a hang — keep the traceback
+    if "platform" not in result:
+        print(f"[bench] jax backend init did not complete within {timeout_s:.0f}s — "
+              "TPU tunnel unreachable; aborting instead of hanging", file=sys.stderr)
+        os._exit(1)
+    return result["n"], result["platform"]
+
+
 def main():
+    rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
+    if rung not in ("zero2", "zero3", "decode"):
+        print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected zero2 | zero3 | decode", file=sys.stderr)
+        return 1
+    n_dev, platform = _probe_backend()
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -112,13 +147,6 @@ def main():
     import deepspeed_tpu.models
     from deepspeed_tpu.models import TransformerConfig
     from deepspeed_tpu.ops.registry import REGISTRY
-
-    rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
-    if rung not in ("zero2", "zero3", "decode"):
-        print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected zero2 | zero3 | decode", file=sys.stderr)
-        return 1
-    n_dev = jax.device_count()
-    platform = jax.devices()[0].platform
     print(f"[bench] platform={platform} devices={n_dev} rung={rung} "
           f"attention={REGISTRY.selected('attention')}", file=sys.stderr)
 

@@ -738,14 +738,6 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag=None, load_module_strict: bool = True,
                         load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
-        if self._fused_pending is not None:
-            # the load wholly replaces params/opt_state/schedule — drop the
-            # pending fused step's bookkeeping rather than committing it onto
-            # (or spuriously blocking) the freshly loaded state
-            self._fused_pending = None
-            self._cached_grads = None
-            log_dist("load_checkpoint: discarding a pending fused step — its state is being overwritten",
-                     ranks=[0])
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILENAME)
             if not os.path.exists(latest):
@@ -754,6 +746,19 @@ class DeepSpeedEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         d = self._ckpt_dir(load_dir, tag)
+        if self._fused_pending is not None:
+            # a FULL load replaces params/opt_state/schedule, so the pending
+            # fused step's bookkeeping can be dropped; a partial load would
+            # leave the already-applied optimizer update inconsistent with
+            # the retained schedule state — refuse that combination
+            if load_module_only or not load_optimizer_states:
+                raise RuntimeError("load_checkpoint: a fused step is pending and this partial load "
+                                   "(load_module_only / load_optimizer_states=False) would not overwrite "
+                                   "the optimizer state it touched; call step() first")
+            self._fused_pending = None
+            self._cached_grads = None
+            log_dist("load_checkpoint: discarding a pending fused step — its state is being overwritten",
+                     ranks=[0])
         params_host = self.checkpoint_engine.load(os.path.join(d, MODEL_STATES_FILENAME),
                                                   template=self.checkpoint_engine.prepare_template(self.params))
         self.params = jax.device_put(params_host, self.param_shardings)

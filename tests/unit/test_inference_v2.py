@@ -288,7 +288,12 @@ class TestDecodeKernelBiasFeatures:
     @pytest.mark.parametrize("feature", ["alibi", "window", "both"])
     def test_matches_gather_reference(self, feature):
         from deepspeed_tpu.models.transformer import alibi_slopes
+        from deepspeed_tpu.ops.pallas import paged_attention as pa
         from deepspeed_tpu.ops.pallas.paged_attention import paged_attention_decode, paged_attention_ref
+
+        if pa.pltpu is None:
+            pytest.skip("pallas TPU submodule unavailable: decode would fall back to the reference "
+                        "path and the comparison would be vacuous")
 
         q, kp, vp, tables, ctx = self._setup()
         sl = alibi_slopes(4) if feature in ("alibi", "both") else None
