@@ -19,7 +19,6 @@ from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..comm import collectives
 from ..parallel.mesh import get_mesh_topology

@@ -16,7 +16,7 @@ no copy until use).
 
 import functools
 import os
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
