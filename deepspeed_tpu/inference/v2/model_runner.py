@@ -33,7 +33,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import TransformerConfig, alibi_slopes, apply_rope, rope_frequencies
-from ...ops.pallas.paged_attention import paged_attention_decode, update_kv_pages
+from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_prefill, update_kv_pages)
 from .modules import _norm_key, _proj, build_modules
 
 
@@ -69,9 +69,17 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
                                  P(None, None, "tensor", None), P(None, None), P(None)),
             out_specs=P(None, "tensor", None), check_vma=False)
         decode_native = False
+        prefill_attn = None
     else:
         decode_attn = functools.partial(
             paged_attention_decode, interpret=interpret,
+            alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
+            window=cfg.sliding_window)
+        # interpret mode (CPU dev serving) keeps the compute-bound prefill on
+        # the fused XLA gather path — emulating the page-walk kernel there is
+        # strictly slower; on real TPU the kernel avoids the context gather
+        prefill_attn = None if interpret else functools.partial(
+            paged_attention_prefill,
             alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
             window=cfg.sliding_window)
         decode_native = True
@@ -103,7 +111,8 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         v_pages = v_pages.at[i].set(vp)
 
         attn = mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
-                              slopes=slopes, decode_attn=decode_attn, decode_native=decode_native)
+                              slopes=slopes, decode_attn=decode_attn, decode_native=decode_native,
+                              prefill_attn=prefill_attn)
         attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
 
         if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j

@@ -16,8 +16,10 @@ Module contracts (all pure functions over the flax param pytree):
 - embedding(cfg, params, input_ids, positions) -> (B, S, d) hidden
 - norm(cfg, p, x) -> normed x        (pre_norm/post_norm collapse to one)
 - attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, *, decode,
-  slopes, decode_attn, decode_native) -> (B, S, H, D) context
-  (``decode_native``: the supplied decode_attn already bakes ALiBi/window)
+  slopes, decode_attn, decode_native, prefill_attn) -> (B, S, H, D)
+  (``decode_native``: decode_attn/prefill_attn already bake ALiBi/window;
+  implementations MUST accept ``**kwargs`` so future call-site arguments
+  don't break registered alternates)
 - mlp(cfg, p, x) -> (B, S, d)
 - moe(cfg, p, x) -> (B, S, d)        (no-drop ragged dispatch)
 - unembed(cfg, params, x, last_token_idx) -> (B, V) fp32 logits
@@ -66,14 +68,18 @@ def norm_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
 
 
 def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, positions, *, decode: bool,
-                  slopes=None, decode_attn: Callable = None, decode_native: bool = False):
+                  slopes=None, decode_attn: Callable = None, decode_native: bool = False,
+                  prefill_attn: Callable = None, **_):
     """ref ``implementations/attention/dense_blocked_attention.py``: Pallas
-    paged decode on the hot path — incl. ALiBi/window baked in-kernel when
-    ``decode_native`` — gather-based reference attention for prefill and
-    for bias-carrying models under TP sharding."""
+    paged kernels on both hot paths — decode and chunked prefill, incl.
+    ALiBi/window baked in-kernel when ``decode_native`` — gather-based
+    reference attention for bias-carrying models under TP sharding."""
     plain = slopes is None and cfg.sliding_window is None
-    if decode and decode_attn is not None and (plain or decode_native):
+    native = plain or decode_native
+    if decode and decode_attn is not None and native:
         return decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
+    if not decode and prefill_attn is not None and native:
+        return prefill_attn(q, kp, vp, block_tables, ctx_lens, positions)
     return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes,
                                window=cfg.sliding_window)
 

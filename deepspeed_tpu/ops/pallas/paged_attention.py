@@ -11,8 +11,9 @@ rotary, ``linear_blocked_kv_rotary/``). TPU re-design:
   and the page index_map dereferences the table, so only live pages are
   streamed from HBM — the paged analogue of flash attention's online
   softmax.
-- Prefill uses the gather-based XLA path (compute-bound; one gather of
-  the context is cheap relative to the matmuls and XLA fuses the mask).
+- Chunked prefill runs the same page-walking kernel shape with a whole
+  query block per sequence (``paged_attention_prefill``); the gather-based
+  XLA path remains as reference/fallback (TP-sharded bias models, CPU).
 
 New KV entries are written with ``update_kv_pages`` via a flat
 "slot mapping" (token -> block*block_size+offset), computed host-side by
@@ -196,3 +197,123 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
         compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("parallel", "arbitrary")) if not interpret and
         hasattr(pltpu, "TPUCompilerParams") else None,
     )(block_tables, ctx_lens, q, k_pages, v_pages, slopes_in)
+
+
+# ------------------------------------------------------------------
+# Pallas chunked-prefill kernel
+# ------------------------------------------------------------------
+def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref, acc_ref,
+                    m_ref, l_ref, *, bs: int, s_q: int, kvh: int, g: int, d: int, pages: int, scale: float,
+                    has_alibi: bool = False, window: int = 0):
+    """Grid (B, pages): stream the live pages of one sequence past a whole
+    chunk of S_q query tokens with online softmax — the prefill sibling of
+    ``_decode_kernel`` (reference blocked_flash over the paged pool).
+    ``qpos0`` is each sequence's absolute position of query row 0 (chunked
+    prefill continues a partially-written context)."""
+    b = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = ctx_lens_ref[b]
+    q0 = qpos0_ref[b]
+    start = p * bs
+    live = start < ctx
+    if window > 0:  # every query row's band ends at its own position; the
+        # earliest key any row can see is q0 - window + 1
+        live = live & (start + bs > q0 - window + 1)
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].reshape(s_q, kvh, g, d).astype(jnp.float32) * scale
+        k = k_ref[0].astype(jnp.float32)  # (bs, kvh, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jnp.einsum("skgd,tkd->kgst", q, k, preferred_element_type=jnp.float32)
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, bs), 3)
+        if has_alibi:
+            sl = slopes_ref[:, 0].reshape(kvh, g)[:, :, None, None]
+            s = s + sl * pos.astype(jnp.float32)
+        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s_q, 1), 2)
+        valid = (pos < ctx) & (pos <= qpos)  # causal against absolute positions
+        if window > 0:
+            valid = valid & (pos > qpos - window)
+        s = jnp.where(valid, s, NEG_INF)
+
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        pij = jnp.exp(s - m_new[..., None])
+        pij = jnp.where(s <= NEG_INF, 0.0, pij)  # rows with no visible key yet
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(pij, axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum("kgst,tkd->kgsd", pij, v)
+        m_ref[...] = m_new
+
+    @pl.when(p == pages - 1)
+    def _finish():
+        l = l_ref[...]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o = acc_ref[...] / l[..., None]  # (kvh, g, s_q, d)
+        o_ref[0] = jnp.transpose(o, (2, 0, 1, 3)).reshape(s_q, kvh * g, d).astype(o_ref.dtype)
+
+
+def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                            block_tables: jnp.ndarray, ctx_lens: jnp.ndarray, q_positions: jnp.ndarray,
+                            scale: Optional[float] = None, interpret: bool = False, alibi_slopes=None,
+                            window: Optional[int] = None) -> jnp.ndarray:
+    """Chunked-prefill attention of a whole query block against the paged
+    context, never gathering pages into a dense (B, L, KVH, D) tensor.
+
+    q: (B, S, H, D) new tokens (S static); q_positions: (B, S) absolute,
+    consecutive per row; ctx_lens: (B,) total context incl. the new tokens.
+    Falls back to the gather reference when pallas-TPU is unavailable.
+    Returns (B, S, H, D).
+    """
+    B, S, H, D = q.shape
+    N, bs, KVH, _ = k_pages.shape
+    P = block_tables.shape[1]
+    G = H // KVH
+    scale = scale if scale is not None else D**-0.5
+    has_alibi = alibi_slopes is not None
+
+    # the fp32 accumulator scratch is (KVH, G, S, D) — VMEM scales linearly
+    # with the chunk length, so long un-chunked prompts (engine put() prefills
+    # whole prompts) fall back to the gather path rather than overflow VMEM
+    acc_bytes = KVH * G * S * D * 4
+    if pltpu is None or S > 512 or acc_bytes > 6 * 2**20:
+        sl = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else None
+        return paged_attention_ref(q, k_pages, v_pages, block_tables, ctx_lens, q_positions, scale,
+                                   alibi_slopes=sl, window=window)
+
+    qpos0 = q_positions[:, 0].astype(jnp.int32)
+    slopes_in = (jnp.broadcast_to(jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1), (H, 128))
+                 if has_alibi else jnp.zeros((H, 128), jnp.float32))
+    kernel = functools.partial(_prefill_kernel, bs=bs, s_q=S, kvh=KVH, g=G, d=D, pages=P, scale=scale,
+                               has_alibi=has_alibi, window=int(window or 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, P),
+        in_specs=[
+            pl.BlockSpec((1, S, H, D), lambda b, p, bt, cl, q0: (b, 0, 0, 0)),
+            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl, q0: (bt[b, p], 0, 0, 0)),
+            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl, q0: (bt[b, p], 0, 0, 0)),
+            pl.BlockSpec((H, 128), lambda b, p, bt, cl, q0: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, S, H, D), lambda b, p, bt, cl, q0: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, G, S, D), jnp.float32),
+            pltpu.VMEM((KVH, G, S), jnp.float32),
+            pltpu.VMEM((KVH, G, S), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
+        interpret=interpret,
+        compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("parallel", "arbitrary")) if not interpret and
+        hasattr(pltpu, "TPUCompilerParams") else None,
+    )(block_tables, ctx_lens, qpos0, q, k_pages, v_pages, slopes_in)

@@ -303,3 +303,37 @@ class TestDecodeKernelBiasFeatures:
         ref = paged_attention_ref(q[:, None], kp, vp, tables, ctx, (ctx - 1)[:, None],
                                   alibi_slopes=slj, window=win)[:, 0]
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=2e-5)
+
+
+class TestPrefillKernel:
+    """Chunked-prefill Pallas kernel vs the gather reference (history
+    continuation, GQA, ALiBi, window)."""
+
+    def _setup(self, B=2, S=8, H=4, KVH=2, D=64, bs=8, P=5, seed=1):
+        rng = np.random.RandomState(seed)
+        n_pages = B * P + 1
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        kp = jnp.asarray(rng.randn(n_pages, bs, KVH, D), jnp.float32)
+        vp = jnp.asarray(rng.randn(n_pages, bs, KVH, D), jnp.float32)
+        tables = jnp.asarray(rng.permutation(n_pages)[:B * P].reshape(B, P), jnp.int32)
+        # row 0: fresh prefill (history 0); row 1: chunked continuation
+        q0 = jnp.asarray([0, 13], jnp.int32)
+        ctx = q0 + S
+        positions = q0[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        return q, kp, vp, tables, ctx, positions
+
+    @pytest.mark.parametrize("feature", ["plain", "alibi", "window", "both"])
+    def test_matches_gather_reference(self, feature):
+        from deepspeed_tpu.models.transformer import alibi_slopes
+        from deepspeed_tpu.ops.pallas import paged_attention as pa
+
+        if pa.pltpu is None:
+            pytest.skip("pallas TPU submodule unavailable")
+        q, kp, vp, tables, ctx, positions = self._setup()
+        sl = alibi_slopes(4) if feature in ("alibi", "both") else None
+        win = 6 if feature in ("window", "both") else None
+        out = pa.paged_attention_prefill(q, kp, vp, tables, ctx, positions, interpret=True,
+                                         alibi_slopes=sl, window=win)
+        slj = jnp.asarray(sl) if sl is not None else None
+        ref = pa.paged_attention_ref(q, kp, vp, tables, ctx, positions, alibi_slopes=slj, window=win)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=2e-5)
