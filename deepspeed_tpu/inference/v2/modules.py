@@ -52,6 +52,8 @@ def _proj(x, p, spec, dtype):
 def embedding_tpu(cfg: TransformerConfig, params: Dict[str, Any], input_ids, positions):
     """ref ``implementations/embedding/ragged_embedding.py``."""
     x = params["wte"][input_ids].astype(cfg.dtype)
+    if cfg.embed_scale:  # gemma normalizer
+        x = x * jnp.asarray(cfg.d_model**0.5, cfg.dtype)
     if cfg.pos_emb == "learned":
         x = x + params["wpe"][positions].astype(cfg.dtype)
     if cfg.embedding_norm:  # bloom — honor a swapped v2_norm here too
@@ -64,7 +66,10 @@ def norm_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
     both roles (the pre/post distinction is call-site placement here)."""
     if "bias" in p:
         return REGISTRY.get("layer_norm")(x, p["scale"], p["bias"], cfg.norm_eps).astype(cfg.dtype)
-    return REGISTRY.get("rms_norm")(x, p["scale"], cfg.norm_eps).astype(cfg.dtype)
+    # the (1+w) offset must add in fp32: serving params may be bf16 and HF's
+    # GemmaRMSNorm computes (1.0 + weight.float()) — the classic gemma pitfall
+    w = 1.0 + p["scale"].astype(jnp.float32) if cfg.rms_offset else p["scale"]
+    return REGISTRY.get("rms_norm")(x, w, cfg.norm_eps).astype(cfg.dtype)
 
 
 def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, positions, *, decode: bool,
@@ -87,8 +92,10 @@ def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, pos
 def mlp_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
     """ref ``implementations/linear/*``: the dense FFN pair."""
     dtype = cfg.dtype
-    if cfg.activation == "swiglu":
-        h = jax.nn.silu(_proj(x, p["gate_proj"], "bsd,df->bsf", dtype)) * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
+    if cfg.activation in ("swiglu", "geglu"):
+        g = _proj(x, p["gate_proj"], "bsd,df->bsf", dtype)
+        g = jax.nn.gelu(g) if cfg.activation == "geglu" else jax.nn.silu(g)
+        h = g * _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
     else:
         h = _proj(x, p["up_proj"], "bsd,df->bsf", dtype)
         if cfg.activation == "relu":

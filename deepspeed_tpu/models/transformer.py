@@ -31,6 +31,7 @@ class TransformerConfig:
     n_layers: int = 2
     n_heads: int = 4
     n_kv_heads: Optional[int] = None  # < n_heads => GQA (llama-70b style)
+    head_dims: Optional[int] = None  # explicit head dim (gemma: != d_model/n_heads)
     d_model: int = 128
     d_ff: Optional[int] = None  # default: 4*d_model (gelu) or 8/3*d_model (swiglu)
     max_seq_len: int = 2048
@@ -50,6 +51,8 @@ class TransformerConfig:
     attn_out_bias: Optional[bool] = None  # override for o_proj only (gpt-j: biased MLP, bias-free attn)
     lm_head_bias: bool = False  # phi / gpt-j carry a bias on the untied head
     embedding_norm: bool = False  # bloom: layernorm directly after the token embedding
+    embed_scale: bool = False  # gemma: scale embeddings by sqrt(d_model)
+    rms_offset: bool = False  # gemma: rmsnorm weights stored zero-centered, applied as (1 + w)
     sliding_window: Optional[int] = None  # mistral: query i attends keys in (i - w, i]
     tie_embeddings: bool = True
     dtype: Any = jnp.float32  # activation/compute dtype
@@ -73,12 +76,14 @@ class TransformerConfig:
     def ffn_dim(self) -> int:
         if self.d_ff is not None:
             return self.d_ff
-        if self.activation == "swiglu":
+        if self.activation in ("swiglu", "geglu"):  # gated MLPs get the 8/3 sizing
             return int(8 * self.d_model / 3 + 127) // 128 * 128 if self.d_model >= 128 else 2 * self.d_model
         return 4 * self.d_model
 
     @property
     def head_dim(self) -> int:
+        if self.head_dims is not None:
+            return self.head_dims
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
 
@@ -106,13 +111,16 @@ class TransformerConfig:
 class RMSNorm(nn.Module):
     eps: float = 1e-5
     dtype: Any = jnp.float32
+    offset: bool = False  # gemma: weights zero-centered, applied as (1 + w)
 
     @nn.compact
     def __call__(self, x):
-        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        init = nn.initializers.zeros if self.offset else nn.initializers.ones
+        scale = self.param("scale", init, (x.shape[-1],), jnp.float32)
         x32 = x.astype(jnp.float32)
         y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
-        return (y * scale).astype(self.dtype)
+        w = 1.0 + scale if self.offset else scale
+        return (y * w).astype(self.dtype)
 
 
 class LayerNorm(nn.Module):
@@ -131,7 +139,9 @@ class LayerNorm(nn.Module):
 
 
 def make_norm(cfg: TransformerConfig):
-    return (RMSNorm if cfg.norm == "rmsnorm" else LayerNorm)(eps=cfg.norm_eps, dtype=cfg.dtype)
+    if cfg.norm == "rmsnorm":
+        return RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, offset=cfg.rms_offset)
+    return LayerNorm(eps=cfg.norm_eps, dtype=cfg.dtype)
 
 
 def rope_frequencies(head_dim: int, max_len: int, theta: float) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -231,10 +241,10 @@ class MLP(nn.Module):
     def __call__(self, x):
         cfg = self.cfg
         bias = cfg.use_dense_bias
-        if cfg.activation == "swiglu":
+        if cfg.activation in ("swiglu", "geglu"):
             gate = nn.Dense(cfg.ffn_dim, use_bias=bias, name="gate_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
             up = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
-            h = nn.silu(gate) * up
+            h = (nn.gelu(gate) if cfg.activation == "geglu" else nn.silu(gate)) * up
         else:
             h = nn.Dense(cfg.ffn_dim, use_bias=bias, name="up_proj", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
             if cfg.activation == "relu":
@@ -308,6 +318,8 @@ class Transformer(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         emb = self.param("wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.d_model), jnp.float32)
         x = emb[input_ids].astype(cfg.dtype)
+        if cfg.embed_scale:  # gemma normalizer
+            x = x * jnp.asarray(cfg.d_model**0.5, cfg.dtype)
         if cfg.pos_emb == "learned":
             wpe = self.param("wpe", nn.initializers.normal(0.02), (cfg.max_seq_len, cfg.d_model), jnp.float32)
             x = x + wpe[positions].astype(cfg.dtype)

@@ -15,8 +15,8 @@ Supported architectures (the reference's policy-container breadth,
 ``module_inject/containers/`` + ``inference/v2/model_implementations/``):
 ``gpt2``, the llama family (``llama``, ``mistral``/``mixtral`` incl.
 sliding-window attention, ``qwen2``), ``opt``, ``gpt_neox`` (pythia),
-``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``, and
-``gpt_bigcode`` (starcoder).
+``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``,
+``gpt_bigcode`` (starcoder), and ``gemma``.
 """
 
 import json
@@ -163,6 +163,28 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                 moe_layer_freq=1,  # every mixtral block is MoE
                 moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
             )
+    elif model_type == "gemma":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 2),
+            n_heads=hf.get("num_attention_heads", 8),
+            n_kv_heads=hf.get("num_key_value_heads", hf.get("num_attention_heads", 8)),
+            head_dims=hf.get("head_dim", 256),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size"),
+            max_seq_len=hf.get("max_position_embeddings", 8192),
+            norm="rmsnorm",
+            rms_offset=True,  # gemma stores zero-centered norm weights: (1 + w)
+            embed_scale=True,  # embeddings scaled by sqrt(d_model)
+            # HF keys both "gelu" (legacy checkpoints, which gemma actually
+            # trained as tanh-approx) and "gelu_pytorch_tanh" to the tanh gate
+            activation="geglu",
+            pos_emb="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            norm_eps=hf.get("rms_norm_eps", 1e-6),
+            dtype=dtype,
+        )
     elif model_type == "opt":
         if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"]:
             raise NotImplementedError("OPT variants with word_embed_proj_dim != hidden_size (350m) "
@@ -733,7 +755,8 @@ _CONVERTERS = {
 
 
 def convert_hf_state_dict(sd: Dict[str, np.ndarray], cfg: TransformerConfig, model_type: str) -> Dict:
-    conv = _CONVERTERS.get(model_type, convert_llama)  # llama/mistral/qwen2/mixtral share one mapping
+    # llama/mistral/qwen2/mixtral/gemma share one key layout
+    conv = _CONVERTERS.get(model_type, convert_llama)
     return conv(sd, cfg)
 
 

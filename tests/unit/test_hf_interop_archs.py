@@ -242,3 +242,42 @@ def test_gpt_bigcode_logits_match(tmp_path, mq):
     torch.manual_seed(40)
     model, _ = _roundtrip(tmp_path / str(mq), transformers.GPTBigCodeForCausalLM(cfg), IDS)
     assert model.cfg.kv_heads == (1 if mq else 4) and model.cfg.pos_emb == "learned"
+
+
+def test_gemma_logits_match(tmp_path):
+    """Gemma: explicit head_dim != d_model/heads, (1+w) rmsnorm, sqrt(d)
+    embedding scale, GeGLU gate, tied head."""
+    cfg = transformers.GemmaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                   num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+                                   max_position_embeddings=64, hidden_act="gelu_pytorch_tanh")
+    torch.manual_seed(50)
+    model, _ = _roundtrip(tmp_path, transformers.GemmaForCausalLM(cfg), IDS)
+    assert model.cfg.head_dim == 32 and model.cfg.rms_offset and model.cfg.embed_scale
+    assert model.cfg.activation == "geglu" and model.cfg.tie_embeddings
+
+
+def test_gemma_v2_serving_and_decode(tmp_path):
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+
+    cfg = transformers.GemmaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                   num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+                                   max_position_embeddings=64, hidden_act="gelu_pytorch_tanh")
+    torch.manual_seed(51)
+    tm = transformers.GemmaForCausalLM(cfg).eval()
+    tm.save_pretrained(tmp_path, safe_serialization=True)
+    model, params = load_hf_checkpoint(str(tmp_path))
+    ids = [3, 17, 42, 9, 88]
+    eng = InferenceEngineV2(
+        model, params,
+        RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
+                                                                    num_kv_blocks=32), dtype="float32"))
+    logits = eng.put([0], [ids])[0]
+    with torch.no_grad():
+        ref = tm(torch.tensor([ids])).logits[0, -1].numpy()
+    np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-4)
+    tok = int(np.argmax(logits))
+    logits2 = eng.put([0], [[tok]])[0]
+    with torch.no_grad():
+        ref2 = tm(torch.tensor([ids + [tok]])).logits[0, -1].numpy()
+    np.testing.assert_allclose(logits2, ref2, rtol=3e-4, atol=3e-4)
