@@ -16,7 +16,7 @@ Supported architectures (the reference's policy-container breadth,
 ``gpt2``, the llama family (``llama``, ``mistral``/``mixtral`` incl.
 sliding-window attention, ``qwen2``), ``opt``, ``gpt_neox`` (pythia),
 ``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``,
-``gpt_bigcode`` (starcoder), and ``gemma``.
+``gpt_bigcode`` (starcoder), ``gemma``, and ``stablelm``.
 """
 
 import json
@@ -163,6 +163,30 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                 moe_layer_freq=1,  # every mixtral block is MoE
                 moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
             )
+    elif model_type == "stablelm":
+        if hf.get("qk_layernorm", False):
+            raise NotImplementedError("stablelm qk_layernorm (per-head q/k norms, stablelm-2-12b) unsupported")
+        if hf.get("use_parallel_residual", False):
+            raise NotImplementedError("stablelm use_parallel_residual variants are unsupported")
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 2),
+            n_heads=hf.get("num_attention_heads", 4),
+            n_kv_heads=hf.get("num_key_value_heads", hf.get("num_attention_heads", 4)),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size"),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            norm="layernorm",
+            activation="swiglu",
+            pos_emb="rope",
+            rotary_pct=hf.get("partial_rotary_factor", 0.25),
+            rope_theta=hf.get("rope_theta", 10000.0),
+            qkv_bias=hf.get("use_qkv_bias", False),
+            dense_bias=False,  # layernorm carries biases but the linears do not
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            norm_eps=hf.get("layer_norm_eps", 1e-5),
+            dtype=dtype,
+        )
     elif model_type == "gemma":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -407,10 +431,18 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     sd = _strip_prefix(sd)
     H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
     dm = cfg.d_model
+
+    def norm_params(prefix: str) -> Dict[str, np.ndarray]:
+        # stablelm uses biased layernorms in the otherwise llama-shaped layout
+        out = {"scale": sd[prefix + ".weight"]}
+        if prefix + ".bias" in sd:
+            out["bias"] = sd[prefix + ".bias"]
+        return out
+
     ln = lambda i: _norm_name(cfg, i)
     params: Dict[str, Any] = {
         "wte": sd["embed_tokens.weight"],
-        ln(0): {"scale": sd["norm.weight"]},
+        ln(0): norm_params("norm" if "norm.weight" in sd else "final_layernorm"),
     }
     if not cfg.tie_embeddings:
         lm_w = sd["lm_head.weight"] if has_lm_head else sd["embed_tokens.weight"]
@@ -418,8 +450,8 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     for i in range(cfg.n_layers):
         p = f"layers.{i}."
         layer = {
-            ln(0): {"scale": sd[p + "input_layernorm.weight"]},
-            ln(1): {"scale": sd[p + "post_attention_layernorm.weight"]},
+            ln(0): norm_params(p + "input_layernorm"),
+            ln(1): norm_params(p + "post_attention_layernorm"),
             "attn": {
                 "q_proj": {"kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(dm, H, D)},
                 "k_proj": {"kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(dm, KVH, D)},

@@ -281,3 +281,17 @@ def test_gemma_v2_serving_and_decode(tmp_path):
     with torch.no_grad():
         ref2 = tm(torch.tensor([ids + [tok]])).logits[0, -1].numpy()
     np.testing.assert_allclose(logits2, ref2, rtol=3e-4, atol=3e-4)
+
+
+@pytest.mark.parametrize("qkv_bias", [False, True])
+def test_stablelm_logits_match(tmp_path, qkv_bias):
+    """StableLM: llama-shaped with biased layernorms, partial rotary, and
+    optionally biased qkv (stablelm2)."""
+    cfg = transformers.StableLmConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                      num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                                      partial_rotary_factor=0.25, use_qkv_bias=qkv_bias,
+                                      tie_word_embeddings=False)
+    torch.manual_seed(60)
+    model, _ = _roundtrip(tmp_path / str(qkv_bias), transformers.StableLmForCausalLM(cfg), IDS)
+    assert model.cfg.norm == "layernorm" and model.cfg.rotary_dim == 4
+    assert model.cfg.use_qkv_bias == qkv_bias and not model.cfg.use_dense_bias
