@@ -16,7 +16,7 @@ Supported architectures (the reference's policy-container breadth,
 ``gpt2``, the llama family (``llama``, ``mistral``/``mixtral`` incl.
 sliding-window attention, ``qwen2``), ``opt``, ``gpt_neox`` (pythia),
 ``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``,
-``gpt_bigcode`` (starcoder), ``gemma``, and ``stablelm``.
+``gpt_bigcode`` (starcoder), ``gemma``, ``stablelm``, and ``phi3``.
 """
 
 import json
@@ -163,6 +163,25 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                 moe_layer_freq=1,  # every mixtral block is MoE
                 moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
             )
+    elif model_type == "phi3":
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 2),
+            n_heads=hf.get("num_attention_heads", 4),
+            n_kv_heads=hf.get("num_key_value_heads", hf.get("num_attention_heads", 4)),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size"),
+            max_seq_len=hf.get("max_position_embeddings", 4096),
+            norm="rmsnorm",
+            activation="swiglu",
+            pos_emb="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            norm_eps=hf.get("rms_norm_eps", 1e-5),
+            dtype=dtype,
+        )
+        if hf.get("sliding_window"):
+            kw["sliding_window"] = int(hf["sliding_window"])
     elif model_type == "stablelm":
         if hf.get("qk_layernorm", False):
             raise NotImplementedError("stablelm qk_layernorm (per-head q/k norms, stablelm-2-12b) unsupported")
@@ -695,6 +714,26 @@ def convert_phi(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     return params
 
 
+def convert_phi3(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``Phi3ForCausalLM`` -> pytree: llama-shaped except the per-layer
+    fused ``qkv_proj`` ([q (H*D), k, v] rows) and ``gate_up_proj``
+    ([gate, up] rows), which are de-fused here and delegated."""
+    H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+    out: Dict[str, np.ndarray] = {}
+    for k, v in sd.items():
+        if k.endswith("self_attn.qkv_proj.weight"):
+            base = k[:-len("qkv_proj.weight")]
+            qw, kw_, vw = np.split(v, [H * D, (H + KVH) * D], axis=0)
+            out[base + "q_proj.weight"], out[base + "k_proj.weight"], out[base + "v_proj.weight"] = qw, kw_, vw
+        elif k.endswith("mlp.gate_up_proj.weight"):
+            base = k[:-len("gate_up_proj.weight")]
+            gw, uw = np.split(v, 2, axis=0)
+            out[base + "gate_proj.weight"], out[base + "up_proj.weight"] = gw, uw
+        else:
+            out[k] = v
+    return convert_llama(out, cfg)
+
+
 def convert_gpt_bigcode(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     """HF ``GPTBigCodeForCausalLM`` (StarCoder) -> pytree: learned positions,
     MQA with contiguous [q (H*D), k (KVH*D), v (KVH*D)] fused rows stored in
@@ -783,6 +822,7 @@ _CONVERTERS = {
     "phi": convert_phi,
     "bloom": convert_bloom,
     "gpt_bigcode": convert_gpt_bigcode,
+    "phi3": convert_phi3,
 }
 
 

@@ -295,3 +295,13 @@ def test_stablelm_logits_match(tmp_path, qkv_bias):
     model, _ = _roundtrip(tmp_path / str(qkv_bias), transformers.StableLmForCausalLM(cfg), IDS)
     assert model.cfg.norm == "layernorm" and model.cfg.rotary_dim == 4
     assert model.cfg.use_qkv_bias == qkv_bias and not model.cfg.use_dense_bias
+
+
+def test_phi3_logits_match(tmp_path):
+    """Phi-3: llama-shaped with fused qkv_proj / gate_up_proj to de-fuse."""
+    cfg = transformers.Phi3Config(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                                  pad_token_id=0, eos_token_id=1, bos_token_id=2, tie_word_embeddings=False)
+    torch.manual_seed(70)
+    model, _ = _roundtrip(tmp_path, transformers.Phi3ForCausalLM(cfg), IDS)
+    assert model.cfg.activation == "swiglu" and not model.cfg.tie_embeddings
