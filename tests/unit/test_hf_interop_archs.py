@@ -305,3 +305,41 @@ def test_phi3_logits_match(tmp_path):
     torch.manual_seed(70)
     model, _ = _roundtrip(tmp_path, transformers.Phi3ForCausalLM(cfg), IDS)
     assert model.cfg.activation == "swiglu" and not model.cfg.tie_embeddings
+
+
+@pytest.mark.parametrize("arch", ["gemma", "falcon40", "stablelm"])
+def test_new_arch_tp2_serving(tmp_path, arch):
+    """Born-sharded TP=2 serving for the architecturally trickiest new
+    families (explicit head_dim, grouped-GQA fused qkv, biased layernorms)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    torch.manual_seed(80)
+    if arch == "gemma":
+        tm = transformers.GemmaForCausalLM(
+            transformers.GemmaConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                     num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+                                     max_position_embeddings=64, hidden_act="gelu_pytorch_tanh"))
+    elif arch == "falcon40":
+        tm = transformers.FalconForCausalLM(
+            transformers.FalconConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+                                      num_kv_heads=2, new_decoder_architecture=True, parallel_attn=True,
+                                      bias=False, alibi=False, tie_word_embeddings=True))
+    else:
+        tm = transformers.StableLmForCausalLM(
+            transformers.StableLmConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                                        max_position_embeddings=64, partial_rotary_factor=0.25,
+                                        tie_word_embeddings=False))
+    tm = tm.eval()
+    tm.save_pretrained(tmp_path, safe_serialization=True)
+    topo = initialize_mesh(MeshConfig.from_dict({"data": 4, "tensor": 2}), force=True)
+    model, params = load_hf_checkpoint(str(tmp_path), mesh=topo, shard=True)
+    eng = deepspeed_tpu.init_inference(model, config={"tensor_parallel": {"tp_size": 2}, "dtype": "fp32"},
+                                       params=params, mesh=topo)
+    with torch.no_grad():
+        ref = tm(torch.from_numpy(np.asarray(IDS, np.int64))).logits.numpy()
+    got = np.asarray(eng.forward(IDS))
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
