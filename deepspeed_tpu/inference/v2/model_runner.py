@@ -34,7 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import TransformerConfig, alibi_slopes, apply_rope, rope_frequencies
 from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_prefill, update_kv_pages)
-from .modules import _norm_key, _proj, build_modules
+from .modules import _norm_p, _proj, build_modules
 
 
 def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
@@ -86,7 +86,6 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
 
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids, positions)
-    norm_key = _norm_key(cfg)
     cos = sin = None
     if cfg.pos_emb == "rope":
         cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
@@ -97,7 +96,7 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
 
     for i in range(cfg.n_layers):
         lp = params[f"layer_{i}"]
-        h = mods.norm(cfg, lp[f"{norm_key}_0"], x)
+        h = mods.norm(cfg, _norm_p(cfg, lp, 0), x)
         q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
         k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
         v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
@@ -118,10 +117,10 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
             ffn_in = h
         elif cfg.block_type == "parallel":  # gpt-neox parallel residual
-            ffn_in = mods.norm(cfg, lp[f"{norm_key}_1"], x)
+            ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
         else:
             x = x + attn_out
-            ffn_in = mods.norm(cfg, lp[f"{norm_key}_1"], x)
+            ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
         ffn_out = mods.moe(cfg, lp["moe"], ffn_in) if _is_moe_layer(cfg, i) else mods.mlp(cfg, lp["mlp"], ffn_in)
         if cfg.block_type in ("parallel", "parallel_shared"):
             x = x + attn_out + ffn_out

@@ -14,7 +14,8 @@ shows serving-module choices alongside kernels.
 
 Module contracts (all pure functions over the flax param pytree):
 - embedding(cfg, params, input_ids, positions) -> (B, S, d) hidden
-- norm(cfg, p, x) -> normed x        (pre_norm/post_norm collapse to one)
+- norm(cfg, p, x) -> normed x        (pre_norm/post_norm collapse to one;
+  p is None iff cfg.norm == "layernorm_np" — param-free olmo norms)
 - attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, *, decode,
   slopes, decode_attn, decode_native, prefill_attn) -> (B, S, H, D)
   (``decode_native``: decode_attn/prefill_attn already bake ALiBi/window;
@@ -39,6 +40,15 @@ def _norm_key(cfg: TransformerConfig) -> str:
     return "RMSNorm" if cfg.norm == "rmsnorm" else "LayerNorm"
 
 
+def _norm_p(cfg: TransformerConfig, container, idx: int):
+    """Resolve a norm's param dict; None ONLY for the param-free norm kind.
+    Parametric norms index strictly so converter regressions fail fast
+    instead of silently degrading to unparameterized normalization."""
+    if cfg.norm == "layernorm_np":
+        return None
+    return container[f"{_norm_key(cfg)}_{idx}"]
+
+
 def _proj(x, p, spec, dtype):
     y = jnp.einsum(spec, x, p["kernel"].astype(dtype))
     if "bias" in p:
@@ -57,13 +67,19 @@ def embedding_tpu(cfg: TransformerConfig, params: Dict[str, Any], input_ids, pos
     if cfg.pos_emb == "learned":
         x = x + params["wpe"][positions].astype(cfg.dtype)
     if cfg.embedding_norm:  # bloom — honor a swapped v2_norm here too
-        x = REGISTRY.get("v2_norm")(cfg, params[f"{_norm_key(cfg)}_0"], x)
+        x = REGISTRY.get("v2_norm")(cfg, _norm_p(cfg, params, 0), x)
     return x
 
 
-def norm_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
+def norm_tpu(cfg: TransformerConfig, p, x):
     """ref ``implementations/{pre_norm,post_norm}/``: one fused norm serves
-    both roles (the pre/post distinction is call-site placement here)."""
+    both roles (the pre/post distinction is call-site placement here).
+    ``p is None`` = non-parametric layernorm (olmo)."""
+    if p is None:
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        return ((x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)).astype(cfg.dtype)
     if "bias" in p:
         return REGISTRY.get("layer_norm")(x, p["scale"], p["bias"], cfg.norm_eps).astype(cfg.dtype)
     # the (1+w) offset must add in fp32: serving params may be bf16 and HF's
@@ -145,7 +161,7 @@ def unembed_tpu(cfg: TransformerConfig, params: Dict[str, Any], x, last_token_id
     """ref ``implementations/unembed/ragged_unembed.py``: final norm +
     last-real-token logits gather + head projection."""
     top = 1 if cfg.embedding_norm else 0
-    x = REGISTRY.get("v2_norm")(cfg, params[f"{_norm_key(cfg)}_{top}"], x)
+    x = REGISTRY.get("v2_norm")(cfg, _norm_p(cfg, params, top), x)
     last = x[jnp.arange(x.shape[0]), last_token_idx, :]
     if cfg.tie_embeddings:
         logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(cfg.dtype))

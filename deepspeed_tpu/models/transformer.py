@@ -35,7 +35,7 @@ class TransformerConfig:
     d_model: int = 128
     d_ff: Optional[int] = None  # default: 4*d_model (gelu) or 8/3*d_model (swiglu)
     max_seq_len: int = 2048
-    norm: str = "layernorm"  # layernorm | rmsnorm
+    norm: str = "layernorm"  # layernorm | rmsnorm | layernorm_np (olmo: no affine params)
     activation: str = "gelu"  # gelu (tanh approx) | gelu_exact (erf) | swiglu | relu
     pos_emb: str = "learned"  # learned | rope | alibi | none
     rope_theta: float = 10000.0
@@ -138,9 +138,24 @@ class LayerNorm(nn.Module):
         return (y * scale + bias).astype(self.dtype)
 
 
+class LayerNormNP(nn.Module):
+    """Non-parametric layernorm (olmo: ``elementwise_affine=False``)."""
+    eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x32 = x.astype(jnp.float32)
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mean), axis=-1, keepdims=True)
+        return ((x32 - mean) * jax.lax.rsqrt(var + self.eps)).astype(self.dtype)
+
+
 def make_norm(cfg: TransformerConfig):
     if cfg.norm == "rmsnorm":
         return RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, offset=cfg.rms_offset)
+    if cfg.norm == "layernorm_np":
+        return LayerNormNP(eps=cfg.norm_eps, dtype=cfg.dtype)
     return LayerNorm(eps=cfg.norm_eps, dtype=cfg.dtype)
 
 
@@ -463,6 +478,9 @@ class CausalLM:
             raise ValueError("disable scan_layers for pipeline (stages are stacked instead)")
         if cfg.embedding_norm:
             raise NotImplementedError("embedding_norm (bloom) models are not pipeline-partitionable yet")
+        if cfg.norm == "layernorm_np":
+            raise NotImplementedError("layernorm_np (olmo) models are not pipeline-partitionable yet "
+                                      "(the head norm is keyed by param name)")
         layers_per_stage = cfg.n_layers // num_stages
 
         if params is None:

@@ -16,7 +16,7 @@ Supported architectures (the reference's policy-container breadth,
 ``gpt2``, the llama family (``llama``, ``mistral``/``mixtral`` incl.
 sliding-window attention, ``qwen2``), ``opt``, ``gpt_neox`` (pythia),
 ``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``,
-``gpt_bigcode`` (starcoder), ``gemma``, ``stablelm``, and ``phi3``.
+``gpt_bigcode`` (starcoder), ``gemma``, ``stablelm``, ``phi3``, and ``olmo``.
 """
 
 import json
@@ -163,6 +163,24 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                 moe_layer_freq=1,  # every mixtral block is MoE
                 moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
             )
+    elif model_type == "olmo":
+        if hf.get("clip_qkv"):
+            raise NotImplementedError("olmo clip_qkv (qkv activation clipping) unsupported")
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 2),
+            n_heads=hf.get("num_attention_heads", 4),
+            n_kv_heads=hf.get("num_key_value_heads", hf.get("num_attention_heads", 4)),
+            d_model=hf["hidden_size"],
+            d_ff=hf.get("intermediate_size"),
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm_np",
+            activation="swiglu",
+            pos_emb="rope",
+            rope_theta=hf.get("rope_theta", 10000.0),
+            tie_embeddings=hf.get("tie_word_embeddings", False),
+            dtype=dtype,
+        )
     elif model_type == "phi3":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -458,19 +476,19 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
             out["bias"] = sd[prefix + ".bias"]
         return out
 
+    np_norm = cfg.norm == "layernorm_np"  # olmo: no affine norm params
     ln = lambda i: _norm_name(cfg, i)
-    params: Dict[str, Any] = {
-        "wte": sd["embed_tokens.weight"],
-        ln(0): norm_params("norm" if "norm.weight" in sd else "final_layernorm"),
-    }
+    params: Dict[str, Any] = {"wte": sd["embed_tokens.weight"]}
+    if not np_norm:
+        params[ln(0)] = norm_params("norm" if "norm.weight" in sd else "final_layernorm")
     if not cfg.tie_embeddings:
         lm_w = sd["lm_head.weight"] if has_lm_head else sd["embed_tokens.weight"]
         params["lm_head"] = {"kernel": lm_w.T}
     for i in range(cfg.n_layers):
         p = f"layers.{i}."
         layer = {
-            ln(0): norm_params(p + "input_layernorm"),
-            ln(1): norm_params(p + "post_attention_layernorm"),
+            **({} if np_norm else {ln(0): norm_params(p + "input_layernorm"),
+                                   ln(1): norm_params(p + "post_attention_layernorm")}),
             "attn": {
                 "q_proj": {"kernel": sd[p + "self_attn.q_proj.weight"].T.reshape(dm, H, D)},
                 "k_proj": {"kernel": sd[p + "self_attn.k_proj.weight"].T.reshape(dm, KVH, D)},

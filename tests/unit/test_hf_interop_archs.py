@@ -343,3 +343,31 @@ def test_new_arch_tp2_serving(tmp_path, arch):
         ref = tm(torch.from_numpy(np.asarray(IDS, np.int64))).logits.numpy()
     got = np.asarray(eng.forward(IDS))
     np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_olmo_logits_match(tmp_path):
+    """OLMo: llama layout with non-parametric layernorms."""
+    cfg = transformers.OlmoConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                                  tie_word_embeddings=False)
+    torch.manual_seed(90)
+    model, params = _roundtrip(tmp_path, transformers.OlmoForCausalLM(cfg), IDS)
+    assert model.cfg.norm == "layernorm_np"
+    import jax.tree_util as jtu
+
+    paths = ["/".join(str(getattr(k, "key", k)) for k in kp) for kp, _ in jtu.tree_flatten_with_path(params)[0]]
+    assert not any("Norm" in path for path in paths)  # genuinely param-free norms, at every level
+
+    # v2 ragged serving handles the param-free norm path too
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig, RaggedInferenceEngineConfig)
+
+    eng = InferenceEngineV2(
+        model, params,
+        RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
+                                                                    num_kv_blocks=32), dtype="float32"))
+    ids = [3, 17, 42]
+    logits = eng.put([0], [ids])[0]
+    tm = transformers.OlmoForCausalLM.from_pretrained(str(tmp_path)).eval()
+    with torch.no_grad():
+        ref = tm(torch.tensor([ids])).logits[0, -1].numpy()
+    np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-4)
