@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import TransformerConfig, alibi_slopes, apply_rope, rope_frequencies
 from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_prefill, update_kv_pages)
+from ...ops.registry import REGISTRY
 from .modules import _norm_p, _proj, build_modules
 
 
@@ -100,6 +101,10 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
         k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
         v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
+        if cfg.qk_norm:  # qwen3: per-head rms before rope
+            rms = REGISTRY.get("rms_norm")
+            q = rms(q, lp["attn"]["q_norm"]["scale"], cfg.norm_eps).astype(dtype)
+            k = rms(k, lp["attn"]["k_norm"]["scale"], cfg.norm_eps).astype(dtype)
         if cfg.pos_emb == "rope":
             q = apply_rope(q, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
             k = apply_rope(k, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)

@@ -48,6 +48,7 @@ class TransformerConfig:
     block_type: str = "sequential"
     dense_bias: Optional[bool] = None  # default: norm == "layernorm" (falcon: LN but bias-free)
     qkv_bias: Optional[bool] = None  # override for q/k/v projections only (qwen2)
+    qk_norm: bool = False  # qwen3: per-head RMSNorm on q/k before rope
     attn_out_bias: Optional[bool] = None  # override for o_proj only (gpt-j: biased MLP, bias-free attn)
     lm_head_bias: bool = False  # phi / gpt-j carry a bias on the untied head
     embedding_norm: bool = False  # bloom: layernorm directly after the token embedding
@@ -223,6 +224,9 @@ class Attention(nn.Module):
         q = dense((H, D), "q_proj")(x)
         k = dense((KVH, D), "k_proj")(x)
         v = dense((KVH, D), "v_proj")(x)
+        if cfg.qk_norm:  # qwen3: head-dim RMSNorm before rope
+            q = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="q_norm")(q)
+            k = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="k_norm")(k)
 
         if cfg.pos_emb == "rope":
             rd = cfg.rotary_dim

@@ -16,7 +16,7 @@ Supported architectures (the reference's policy-container breadth,
 ``gpt2``, the llama family (``llama``, ``mistral``/``mixtral`` incl.
 sliding-window attention, ``qwen2``), ``opt``, ``gpt_neox`` (pythia),
 ``gptj``, ``falcon`` (7b and 40b styles), ``phi``, ``bloom``,
-``gpt_bigcode`` (starcoder), ``gemma``, ``stablelm``, ``phi3``, and ``olmo``.
+``gpt_bigcode`` (starcoder), ``gemma``, ``stablelm``, ``phi3``, ``olmo``, and ``qwen3``.
 """
 
 import json
@@ -121,7 +121,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             dtype=dtype,
         )
-    elif model_type in ("llama", "mistral", "qwen2", "mixtral", ""):
+    elif model_type in ("llama", "mistral", "qwen2", "qwen3", "mixtral", ""):
         kw = dict(
             vocab_size=hf["vocab_size"],
             n_layers=hf.get("num_hidden_layers", 2),
@@ -140,13 +140,17 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         )
         if model_type == "qwen2":
             kw["qkv_bias"] = True
+        if model_type == "qwen3":
+            kw["qk_norm"] = True
+            if hf.get("head_dim"):
+                kw["head_dims"] = int(hf["head_dim"])
         if model_type in ("mistral", "mixtral") and hf.get("sliding_window"):
             kw["sliding_window"] = int(hf["sliding_window"])
         # qwen2 gates its window behind use_sliding_window, and HF applies it
         # only to layers with idx >= max_window_layers; one global window can
         # express the all-layers (mwl <= 0) and no-layers (mwl >= n_layers)
         # cases — mixed per-layer configs are rejected rather than mis-served
-        if model_type == "qwen2" and hf.get("use_sliding_window") and hf.get("sliding_window"):
+        if model_type in ("qwen2", "qwen3") and hf.get("use_sliding_window") and hf.get("sliding_window"):
             mwl = int(hf.get("max_window_layers", 28))  # HF Qwen2Config default
             n_layers = kw["n_layers"]
             if mwl <= 0:
@@ -513,6 +517,9 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
                 "up_proj": {"kernel": sd[p + "mlp.up_proj.weight"].T},
                 "down_proj": {"kernel": sd[p + "mlp.down_proj.weight"].T},
             }
+        if cfg.qk_norm:  # qwen3 per-head q/k norms
+            layer["attn"]["q_norm"] = {"scale": sd[p + "self_attn.q_norm.weight"]}
+            layer["attn"]["k_norm"] = {"scale": sd[p + "self_attn.k_norm.weight"]}
         # qwen2 carries attention biases
         for proj, heads in (("q_proj", H), ("k_proj", KVH), ("v_proj", KVH)):
             bkey = p + f"self_attn.{proj}.bias"

@@ -371,3 +371,27 @@ def test_olmo_logits_match(tmp_path):
     with torch.no_grad():
         ref = tm(torch.tensor([ids])).logits[0, -1].numpy()
     np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_qwen3_logits_match(tmp_path):
+    """Qwen3: llama layout + per-head q/k RMSNorm before rope + explicit
+    head_dim, served v1 and v2."""
+    cfg = transformers.Qwen3Config(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                   num_attention_heads=4, num_key_value_heads=2, head_dim=32,
+                                   max_position_embeddings=64, tie_word_embeddings=False)
+    torch.manual_seed(100)
+    model, params = _roundtrip(tmp_path, transformers.Qwen3ForCausalLM(cfg), IDS)
+    assert model.cfg.qk_norm and model.cfg.head_dim == 32
+
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig, RaggedInferenceEngineConfig)
+
+    eng = InferenceEngineV2(
+        model, params,
+        RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
+                                                                    num_kv_blocks=32), dtype="float32"))
+    ids = [3, 17, 42, 9]
+    logits = eng.put([0], [ids])[0]
+    tm = transformers.Qwen3ForCausalLM.from_pretrained(str(tmp_path)).eval()
+    with torch.no_grad():
+        ref = tm(torch.tensor([ids])).logits[0, -1].numpy()
+    np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-4)
