@@ -101,12 +101,40 @@ def _map_gelu(hf_act: str) -> str:
     return "gelu"
 
 
+def _reject_rope_scaling(hf: Dict[str, Any]) -> None:
+    """Refuse checkpoints whose rope needs scaling we don't implement.
+
+    HF ``rope_scaling`` (llama3, qwen yarn, phi3 longrope, linear/dynamic
+    NTK) changes the rotary frequencies; loading such a checkpoint with the
+    base rope would produce logits that silently diverge beyond the base
+    context window.  A trivial entry (``type``/``rope_type`` of ``default``
+    with ``factor`` 1) is a no-op and is allowed through.
+    """
+    rs = hf.get("rope_scaling") or hf.get("rope_parameters")
+    if not isinstance(rs, dict):
+        return
+    kind = rs.get("rope_type", rs.get("type", "default"))
+    factor = rs.get("factor", 1.0)
+    if factor is None or float(factor) == 1.0:
+        # identity scaling: default always; linear/dynamic interpolate by
+        # `factor` alone, so factor==1 leaves every frequency unchanged
+        # (yarn/llama3/longrope carry extra parameters — still rejected)
+        if kind in (None, "default", "linear", "dynamic"):
+            return
+    raise NotImplementedError(
+        f"HF config requests rope_scaling={rs!r} ({hf.get('model_type', '?')}); "
+        "scaled-rope variants (linear/dynamic/yarn/llama3/longrope) are not "
+        "implemented — logits would silently diverge past the base context"
+    )
+
+
 def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerConfig:
     """Map an HF ``config.json`` dict to :class:`TransformerConfig`."""
     import jax.numpy as jnp
 
     model_type = hf.get("model_type", "")
     dtype = dtype if dtype is not None else jnp.float32
+    _reject_rope_scaling(hf)
     if model_type == "gpt2":
         kw = dict(
             vocab_size=hf["vocab_size"],

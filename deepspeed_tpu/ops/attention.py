@@ -74,7 +74,9 @@ def attention_xla(q: jnp.ndarray,
         if causal:
             mask = mask & (ki <= qi)
         if window is not None:
-            mask = mask & (ki > qi - window)
+            # window means '(i - window, i]' — it implies the causal upper
+            # bound even when causal=False, matching the flash kernel
+            mask = mask & (ki > qi - window) & (ki <= qi)
         logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
     if segment_ids is not None:
         seg_q, seg_k = segment_ids if isinstance(segment_ids, tuple) else (segment_ids, segment_ids)

@@ -39,7 +39,27 @@ def _pick_chunk(S: int, target: Optional[int] = None) -> int:
     for c in (target, 512, 256, 128, 64, 32):
         if c <= target and S % c == 0 and c <= S:
             return c
-    return min(S, target) if S % min(S, target) == 0 else S
+    # no power-of-two-ish candidate divides S (prime/odd S): take the
+    # largest divisor of S that still respects the target
+    best = 1
+    d = 1
+    while d * d <= S:
+        if S % d == 0:
+            for c in (d, S // d):
+                if best < c <= target:
+                    best = c
+        d += 1
+    if best >= 32:
+        return best
+    # only tiny divisors exist (prime-ish S): chunk=1..31 would serialize the
+    # projection into S near-scalar matmuls — worse than the memory blowup.
+    # Take the full block and say so instead of silently cliffing either way.
+    import warnings
+    warnings.warn(
+        f"fused CE: seq len {S} has no divisor in [32, {target}]; using a single "
+        f"(B, {S}, V) logits block — set DS_TPU_CE_CHUNK or pad S to a multiple "
+        "of a power of two to restore chunking", stacklevel=2)
+    return S
 
 
 def _project(xs: jnp.ndarray, w: jnp.ndarray, vd_layout: bool) -> jnp.ndarray:

@@ -30,6 +30,8 @@ class Eigenvalue:
         if gas_boundary_resolution < 1:
             raise ValueError(f"gas_boundary_resolution must be >= 1, got {gas_boundary_resolution} "
                              "(set eigenvalue.enabled=false to disable the pass)")
+        if max_iter < 1:
+            raise ValueError(f"max_iter must be >= 1, got {max_iter}")
         self.verbose = verbose
         self.max_iter = max_iter
         self.tol = tol

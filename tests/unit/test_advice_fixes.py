@@ -1,0 +1,85 @@
+"""Regression tests for the round-2 advisor findings (ADVICE.md)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.module_inject.load_checkpoint import config_from_hf
+from deepspeed_tpu.ops.attention import attention_xla
+from deepspeed_tpu.ops.fused_ce import _pick_chunk
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+
+LLAMA_BASE = {
+    "model_type": "llama",
+    "vocab_size": 64,
+    "num_hidden_layers": 1,
+    "num_attention_heads": 2,
+    "num_key_value_heads": 2,
+    "hidden_size": 16,
+    "intermediate_size": 32,
+}
+
+
+class TestRopeScalingRejected:
+    def test_nontrivial_rope_scaling_raises(self):
+        hf = dict(LLAMA_BASE, rope_scaling={"rope_type": "llama3", "factor": 8.0})
+        with pytest.raises(NotImplementedError, match="rope_scaling"):
+            config_from_hf(hf)
+
+    @pytest.mark.parametrize("kind", ["linear", "dynamic", "yarn", "longrope"])
+    def test_all_variants_rejected(self, kind):
+        hf = dict(LLAMA_BASE, rope_scaling={"type": kind, "factor": 2.0})
+        with pytest.raises(NotImplementedError):
+            config_from_hf(hf)
+
+    def test_trivial_or_absent_rope_scaling_ok(self):
+        config_from_hf(dict(LLAMA_BASE))  # absent
+        config_from_hf(dict(LLAMA_BASE, rope_scaling=None))
+        config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "default", "factor": 1.0}))
+        # linear/dynamic at factor 1.0 are identity scalings — must load
+        config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "linear", "factor": 1.0}))
+        config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "dynamic", "factor": 1.0}))
+        # yarn carries extra params even at factor 1 — still rejected
+        with pytest.raises(NotImplementedError):
+            config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "yarn", "factor": 1.0}))
+
+
+class TestWindowWithoutCausal:
+    def test_window_implies_upper_bound(self):
+        """window='(i-w, i]' must hold even with causal=False."""
+        rng = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(rng, 3)
+        q = jax.random.normal(kq, (1, 8, 2, 4), jnp.float32)
+        k = jax.random.normal(kk, (1, 8, 2, 4), jnp.float32)
+        v = jax.random.normal(kv, (1, 8, 2, 4), jnp.float32)
+        o_nc = attention_xla(q, k, v, causal=False, window=3)
+        o_c = attention_xla(q, k, v, causal=True, window=3)
+        np.testing.assert_allclose(np.asarray(o_nc), np.asarray(o_c), rtol=1e-6)
+
+
+class TestEigenvalueMaxIter:
+    def test_max_iter_zero_rejected(self):
+        with pytest.raises(ValueError, match="max_iter"):
+            Eigenvalue(max_iter=0)
+
+    def test_max_iter_negative_rejected(self):
+        with pytest.raises(ValueError, match="max_iter"):
+            Eigenvalue(max_iter=-3)
+
+
+class TestPickChunkDivisor:
+    def test_prime_seq_len_warns_and_takes_full_block(self):
+        with pytest.warns(UserWarning, match="no divisor"):
+            c = _pick_chunk(509, target=128)  # 509 is prime
+        assert c == 509  # full block beats 509 near-scalar matmuls
+
+    def test_odd_composite_picks_largest_divisor(self):
+        c = _pick_chunk(513, target=128)  # 513 = 27 * 19
+        assert c == 57  # largest divisor of 513 that is <= 128
+        assert 513 % c == 0
+
+    def test_divisible_unchanged(self):
+        assert _pick_chunk(1024, target=512) == 512
+        assert _pick_chunk(96, target=512) == 32  # first power-of-two candidate that divides
