@@ -257,7 +257,7 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
         raise KeyError(f"universal checkpoint at {root} missing params: {missing[:5]}...")
     params_flat = _read_flat(zdir, FP32, list(tmpl_flat.keys()))
     params_host = from_state_dict(template_host, unflatten_named(params_flat))
-    engine.params = jax.device_put(params_host, engine.param_shardings)
+    engine.params = jax.device_put(params_host, getattr(engine, 'param_store_shardings', engine.param_shardings))
 
     offload = getattr(engine, "_host_offload", None)
     if offload is not None:

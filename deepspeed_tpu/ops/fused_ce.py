@@ -49,7 +49,7 @@ def _pick_chunk(S: int, target: Optional[int] = None) -> int:
                 if best < c <= target:
                     best = c
         d += 1
-    if best >= 32:
+    if best >= min(32, S):
         return best
     # only tiny divisors exist (prime-ish S): chunk=1..31 would serialize the
     # projection into S near-scalar matmuls — worse than the memory blowup.

@@ -121,7 +121,25 @@ class DeepSpeedEngine:
         param_shapes = jax.eval_shape(lambda: params_host)
         self.param_specs = plan_param_specs(param_shapes, self.config, self.topology, tp_rules)
         self.param_shardings = specs_to_shardings(self.param_specs, self.topology)
-        self.params = jax.device_put(params_host, self.param_shardings)
+
+        # ZeRO-3 parameter offload: large leaves stored in pinned host
+        # memory, streamed to HBM inside each compiled step (reference
+        # partitioned_param_swapper.py:36, wired at stage3.py:583)
+        from .zero.param_offload import maybe_enable_param_offload
+        from .zero.zeropp import zeropp_applicable as _zpp_applicable
+
+        # gate on the path that will actually run: merely *requesting* ZeRO++
+        # on an ineligible topology falls back to GSPMD, where offload works
+        _zpp_active = (_zpp_applicable(self.config, self.topology)[0]
+                       and not self.config.compression_config)
+        if _zpp_active and self.config.zero_config.offload_param.device in ("cpu", "nvme"):
+            logger.warning("offload_param is incompatible with the ZeRO++ manual shard_map path — "
+                           "parameters stay in device memory")
+            self.param_store_shardings, self._param_offload = self.param_shardings, False
+        else:
+            self.param_store_shardings, self._param_offload = maybe_enable_param_offload(
+                self.config, self.topology, self.param_shardings, param_shapes)
+        self.params = jax.device_put(params_host, self.param_store_shardings)
         del params_host
 
         self.grad_specs = plan_grad_specs(param_shapes, self.param_specs, self.config, self.topology)
@@ -148,11 +166,15 @@ class DeepSpeedEngine:
             else:
                 from .zero.offload import HostOffloadOptimizer
 
+                off_p = self.config.zero_config.offload_param
                 self._host_offload = HostOffloadOptimizer(jax.device_get(self.params),
                                                           self.config.optimizer.params, offload_device=off.device,
                                                           nvme_path=off.nvme_path,
                                                           aio_threads=self.config.aio.thread_count,
-                                                          pipeline=off.pipeline_read or off.pipeline_write)
+                                                          pipeline=off.pipeline_read or off.pipeline_write,
+                                                          params_on_nvme=(off_p.device == "nvme"
+                                                                          and bool(self._param_offload)),
+                                                          params_nvme_path=off_p.nvme_path)
         if self._host_offload is None:
             opt_specs, _ = plan_opt_state_specs(self.optimizer, param_shapes, self.param_specs, self.config,
                                                 self.topology)
@@ -288,6 +310,22 @@ class DeepSpeedEngine:
         comp = self.compression_engine
         base_rng = self._rng
 
+        from .zero.param_offload import fetch_params
+
+        store_shardings = self.param_store_shardings
+        jit_stream = self._param_offload == "jit"
+        # jit mode: compiled fns consume the host store directly (fetch is
+        # traced in, updated params stream back via host-kind out_shardings).
+        # eager mode: compiled fns are plain device functions and the swap
+        # happens in wrappers built at the end of this method.
+        param_out_shardings = store_shardings if jit_stream else self.param_shardings
+
+        def _fetch(params32):
+            # host->HBM stream of offloaded leaves, traced into the jit so
+            # XLA overlaps the DMA with compute (grads are taken w.r.t. the
+            # fetched device copy, so they land in device memory)
+            return fetch_params(params32, store_shardings) if jit_stream else params32
+
         def scaled_loss_fn(params32, batch, rng, scale, comp_state):
             params_c = _cast_tree(params32, compute_dtype)
             if comp is not None:
@@ -299,7 +337,7 @@ class DeepSpeedEngine:
             # rng derivation lives inside the jit: one less per-step dispatch
             rng = jax.random.fold_in(base_rng, step)
             (scaled, raw_loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(
-                params32, batch, rng, scale, comp_state)
+                _fetch(params32), batch, rng, scale, comp_state)
             return raw_loss, grads
 
         from .zero.zeropp import build_zeropp_fwd_bwd, zeropp_applicable, zeropp_requested
@@ -330,6 +368,7 @@ class DeepSpeedEngine:
         opt = self.optimizer
 
         def apply_updates(params32, opt_state, acc_grads, inv_scale, lr):
+            params32 = _fetch(params32)
             grads = jax.tree_util.tree_map(lambda g: g.astype(jnp.float32) * inv_scale, acc_grads)
             finite = _all_finite(grads)
             gnorm = _global_norm(grads)
@@ -350,7 +389,8 @@ class DeepSpeedEngine:
         # one-to-one (donating grads too leaves an unusable donated buffer —
         # XLA's "Some donated buffers were not usable" warning)
         self._apply_updates = jax.jit(apply_updates, donate_argnums=(0, 1),
-                                      out_shardings=(self.param_shardings, self.opt_state_shardings, None, None))
+                                      out_shardings=(param_out_shardings, self.opt_state_shardings,
+                                                     None, None))
 
         # one-dispatch fused step: fwd+bwd+optimizer in a single XLA module.
         # Same math and rng derivation as the split path (XLA can overlap the
@@ -365,21 +405,22 @@ class DeepSpeedEngine:
 
             def fused_step(params32, opt_state, batch, step, scale, inv_scale, lr):
                 rng = jax.random.fold_in(base_rng, step)
+                params_dev = _fetch(params32)  # one stream-in, shared by grad + update
                 (_, raw_loss), grads = jax.value_and_grad(scaled_loss_fn, has_aux=True)(
-                    params32, batch, rng, scale, None)
-                new_params, new_opt_state, gnorm, overflow = apply_updates(params32, opt_state, grads,
+                    params_dev, batch, rng, scale, None)
+                new_params, new_opt_state, gnorm, overflow = apply_updates(params_dev, opt_state, grads,
                                                                            inv_scale, lr)
                 return raw_loss, new_params, new_opt_state, gnorm, overflow
 
             self._fused_step = jax.jit(
                 fused_step, donate_argnums=(0, 1),
-                out_shardings=(None, self.param_shardings, self.opt_state_shardings, None, None))
+                out_shardings=(None, param_out_shardings, self.opt_state_shardings, None, None))
             if self.config.wall_clock_breakdown:
                 log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
                          "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
 
         def eval_loss(params32, batch, rng):
-            params_c = _cast_tree(params32, compute_dtype)
+            params_c = _cast_tree(_fetch(params32), compute_dtype)
             return loss_fn(params_c, batch, rng)
 
         self._eval_loss = jax.jit(eval_loss)
@@ -388,6 +429,35 @@ class DeepSpeedEngine:
             return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params32)
 
         self._zero_grads = jax.jit(zeros_like_sharded, out_shardings=self.grad_shardings)
+
+        if self._param_offload == "eager":
+            # engine-level swap: async device_put of the host store before
+            # each compiled call, updated params put back after (the
+            # transient device copy is freed when its last reference drops)
+            dev_sh, host_sh = self.param_shardings, store_shardings
+            base_fwd_bwd, base_apply = self._fwd_bwd, self._apply_updates
+            base_eval = self._eval_loss
+
+            self._fwd_bwd = lambda p, b, step, s: base_fwd_bwd(jax.device_put(p, dev_sh), b, step, s)
+            self._eval_loss = lambda p, b, rng: base_eval(jax.device_put(p, dev_sh), b, rng)
+
+            def apply_with_swap(params_host, opt_state, acc_grads, inv_scale, lr):
+                new_p, new_opt, gnorm, ovf = base_apply(jax.device_put(params_host, dev_sh),
+                                                        opt_state, acc_grads, inv_scale, lr)
+                return jax.device_put(new_p, host_sh), new_opt, gnorm, ovf
+
+            self._apply_updates = apply_with_swap
+
+            if self._fused_step is not None:
+                base_fused = self._fused_step
+
+                def fused_with_swap(params_host, opt_state, batch, step, scale, inv_scale, lr):
+                    loss, new_p, new_opt, gnorm, ovf = base_fused(jax.device_put(params_host, dev_sh),
+                                                                  opt_state, batch, step, scale,
+                                                                  inv_scale, lr)
+                    return loss, jax.device_put(new_p, host_sh), new_opt, gnorm, ovf
+
+                self._fused_step = fused_with_swap
 
     # ------------------------------------------------------------------
     # data
@@ -405,7 +475,12 @@ class DeepSpeedEngine:
             leaves = jax.tree_util.tree_leaves(batch)
             if leaves and isinstance(leaves[0], jax.Array) and leaves[0].committed:
                 return batch
-        shardings = specs_to_shardings(batch_specs(batch, self.topology), self.topology)
+        # sequence/context parallelism: tokens shard over the seq axes too
+        # (reference sequence_parallel_size — Ulysses/ring CP input layout);
+        # GSPMD inserts the attention collectives from this layout
+        sp = (self.topology.axis_size("seq") > 1 or self.topology.axis_size("context") > 1)
+        shardings = specs_to_shardings(batch_specs(batch, self.topology, seq_axis_for_dim1=sp),
+                                       self.topology)
         return jax.device_put(batch, shardings)
 
     # ------------------------------------------------------------------
@@ -512,9 +587,10 @@ class DeepSpeedEngine:
             if self._host_offload is not None:
                 new_params, gnorm, overflow = self._host_offload.step(jax.device_get(self._grad_acc), lr,
                                                                       inv_scale=inv_scale,
-                                                                      grad_clip=self.config.gradient_clipping)
+                                                                      grad_clip=self.config.gradient_clipping,
+                                                                      shardings=self.param_store_shardings)
                 if not overflow:
-                    self.params = jax.device_put(new_params, self.param_shardings)
+                    self.params = new_params
             else:
                 self.params, self.opt_state, gnorm, overflow = self._apply_updates(
                     self.params, self.opt_state, self._grad_acc, inv_scale, lr)
@@ -761,7 +837,7 @@ class DeepSpeedEngine:
                      ranks=[0])
         params_host = self.checkpoint_engine.load(os.path.join(d, MODEL_STATES_FILENAME),
                                                   template=self.checkpoint_engine.prepare_template(self.params))
-        self.params = jax.device_put(params_host, self.param_shardings)
+        self.params = jax.device_put(params_host, self.param_store_shardings)
         if self._host_offload is not None:
             # keep the host master copies in sync even when optimizer states
             # are not loaded, or the next step reverts to init-time weights

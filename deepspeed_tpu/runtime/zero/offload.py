@@ -31,13 +31,36 @@ class HostOffloadOptimizer:
     """Adam(W) over host-resident fp32 master weights and moments."""
 
     def __init__(self, params_host, optimizer_params: Dict, offload_device: str = "cpu",
-                 nvme_path: Optional[str] = None, aio_threads: int = 4, pipeline: bool = True):
+                 nvme_path: Optional[str] = None, aio_threads: int = 4, pipeline: bool = True,
+                 params_on_nvme: bool = False, params_nvme_path: Optional[str] = None):
         p = dict(optimizer_params or {})
         self._adam = DeepSpeedCPUAdam(lr=p.get("lr", 1e-3), betas=tuple(p.get("betas", (0.9, 0.999))),
                                       eps=p.get("eps", 1e-8), weight_decay=p.get("weight_decay", 0.01),
                                       adamw_mode=p.get("adam_w_mode", True))
         leaves, self._treedef = jax.tree_util.tree_flatten(params_host)
-        self._master: List[np.ndarray] = [np.ascontiguousarray(np.asarray(x, np.float32)) for x in leaves]
+        self._master_folder: Optional[str] = None
+        if params_on_nvme:
+            # ZeRO-Infinity param NVMe offload (reference
+            # partitioned_param_swapper.py): fp32 masters are disk-backed
+            # memmaps — host RAM holds only the OS page-cache working set,
+            # and the in-place CPU Adam writes straight through to NVMe
+            self._master_folder = params_nvme_path or nvme_path or tempfile.mkdtemp(prefix="ds_tpu_param_nvme_")
+            self._master = []
+            for i, x in enumerate(leaves):
+                shape = tuple(np.shape(x))
+                if not shape:  # scalar leaves aren't worth a disk file
+                    self._master.append(np.array(x, np.float32, copy=True))
+                    continue
+                mm = np.memmap(f"{self._master_folder}/master_{i}.bin", dtype=np.float32,
+                               mode="w+", shape=shape)
+                mm[...] = np.asarray(x, np.float32)
+                self._master.append(mm)
+            log_dist(f"ZeRO-Infinity: fp32 master params memmapped on NVMe at "
+                     f"{self._master_folder}", ranks=[0])
+        else:
+            # force real copies: np.asarray of a host-resident jax array is a
+            # zero-copy view, and these buffers are mutated in place every step
+            self._master: List[np.ndarray] = [np.array(x, np.float32, copy=True) for x in leaves]
         self._names = [f"param_{i}" for i in range(len(self._master))]
         self.device = offload_device
 
@@ -56,8 +79,14 @@ class HostOffloadOptimizer:
 
     # ------------------------------------------------------------------
     def step(self, grads_host, lr: float, inv_scale: float = 1.0,
-             grad_clip: float = 0.0) -> Tuple[Any, float, bool]:
-        """Step all parameters; returns (new_params_tree, grad_norm, overflow)."""
+             grad_clip: float = 0.0, shardings=None) -> Tuple[Any, float, bool]:
+        """Step all parameters; returns (new_params_tree, grad_norm, overflow).
+
+        With ``shardings`` (a pytree of shardings matching the params), the
+        returned tree is device-put leaf-by-leaf — at most one transient
+        host copy per leaf, which keeps the NVMe-memmap path's RAM use at
+        the working-set level instead of materializing the full master set.
+        """
         gleaves = jax.tree_util.tree_flatten(grads_host)[0]
         grads = [np.asarray(g, np.float32) * inv_scale for g in gleaves]
 
@@ -65,7 +94,7 @@ class HostOffloadOptimizer:
         gnorm = float(np.sqrt(sq))
         overflow = not np.isfinite(gnorm)
         if overflow:
-            return jax.tree_util.tree_unflatten(self._treedef, list(self._master)), gnorm, True
+            return self._out_tree(shardings), gnorm, True
         if grad_clip > 0.0:
             coef = min(1.0, grad_clip / (gnorm + 1e-6))
             if coef < 1.0:
@@ -88,7 +117,7 @@ class HostOffloadOptimizer:
                 self._adam.step(m, np.ascontiguousarray(g), st["exp_avg"], st["exp_avg_sq"], lr=lr, step=step)
                 self._swapper.commit(self._names[i], st)
             self._swapper.synchronize()
-        return jax.tree_util.tree_unflatten(self._treedef, list(self._master)), gnorm, False
+        return self._out_tree(shardings), gnorm, False
 
     # ------------------------------------------------------------------
     def state_dict(self) -> Dict:
@@ -96,8 +125,9 @@ class HostOffloadOptimizer:
             moments = [self._swapper.fetch(n, _STATE_NAMES) for n in self._names]
         else:
             moments = self._moments
-        return {"step": self._adam.step_count, "master": list(self._master),
-                "moments": [{k: v for k, v in st.items()} for st in moments]}
+        # copies: an async checkpoint writer must not see later in-place steps
+        return {"step": self._adam.step_count, "master": [np.array(m) for m in self._master],
+                "moments": [{k: np.array(v) for k, v in st.items()} for st in moments]}
 
     def template_state_dict(self) -> Dict:
         """Structure-only state (for checkpoint-load templates): no NVMe
@@ -105,9 +135,16 @@ class HostOffloadOptimizer:
         return {"step": 0, "master": [np.zeros_like(m) for m in self._master],
                 "moments": [{s: np.zeros_like(m) for s in _STATE_NAMES} for m in self._master]}
 
+    def _set_master_values(self, leaves) -> None:
+        if self._master_folder is not None:
+            for m, x in zip(self._master, leaves):  # write through to the memmaps
+                m[...] = np.asarray(x, np.float32)
+        else:
+            self._master = [np.array(x, np.float32, copy=True) for x in leaves]
+
     def load_state_dict(self, sd: Dict) -> None:
         self._adam.step_count = int(sd["step"])
-        self._master = [np.ascontiguousarray(np.asarray(x, np.float32)) for x in sd["master"]]
+        self._set_master_values(sd["master"])
         if self._swapper is not None:
             for n, st in zip(self._names, sd["moments"]):
                 self._swapper.commit(n, {k: np.ascontiguousarray(np.asarray(v, np.float32)) for k, v in st.items()},
@@ -116,9 +153,22 @@ class HostOffloadOptimizer:
             self._moments = [{k: np.ascontiguousarray(np.asarray(v, np.float32)) for k, v in st.items()}
                              for st in sd["moments"]]
 
+    def _out_tree(self, shardings=None):
+        if shardings is None:
+            return self.params_tree
+        # leaf-wise copy + put: the per-leaf host copy is released as soon
+        # as its transfer lands, so peak extra RAM is one leaf, not the
+        # whole fp32 master set (matters for the NVMe-memmap store)
+        sh_leaves = jax.tree_util.tree_flatten(shardings)[0]
+        out = [jax.device_put(np.array(m, np.float32), sh) for m, sh in zip(self._master, sh_leaves)]
+        return jax.tree_util.tree_unflatten(self._treedef, out)
+
     @property
     def params_tree(self):
-        return jax.tree_util.tree_unflatten(self._treedef, list(self._master))
+        # copies, not the live buffers: jax.device_put of a host numpy array
+        # can be zero-copy, and the masters mutate in place every step — an
+        # aliased engine.params would silently change under XLA's feet
+        return jax.tree_util.tree_unflatten(self._treedef, [np.array(m) for m in self._master])
 
     @property
     def step_count(self) -> int:
@@ -129,8 +179,7 @@ class HostOffloadOptimizer:
         self._adam.step_count = int(v)
 
     def set_master(self, params_tree) -> None:
-        leaves = jax.tree_util.tree_flatten(params_tree)[0]
-        self._master = [np.ascontiguousarray(np.asarray(x, np.float32)) for x in leaves]
+        self._set_master_values(jax.tree_util.tree_flatten(params_tree)[0])
 
     def moments_trees(self) -> List[Any]:
         """Param-shaped trees, one per optimizer state (universal ckpt I/O)."""

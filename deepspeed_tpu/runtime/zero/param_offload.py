@@ -1,0 +1,141 @@
+"""ZeRO-3 parameter offload: host-resident parameters streamed per step.
+
+Capability parity: reference ZeRO-Infinity parameter offload —
+``swap_tensor/partitioned_param_swapper.py:36``
+(``AsyncPartitionedParameterSwapper``) wired at ``runtime/zero/stage3.py:583``:
+partitioned parameters live off-device (CPU/NVMe), are fetched into HBM on
+use by the param coordinator's prefetch pipeline, and are released after.
+
+TPU-native design: XLA memory kinds instead of a hand-rolled swapper.
+
+- The stored (ZeRO-sharded) master parameters get
+  ``NamedSharding(..., memory_kind="pinned_host")`` — they occupy pinned
+  host RAM, not HBM, while keeping their mesh sharding.
+- ``"jit"`` mode: inside each compiled step the offloaded leaves are
+  ``jax.device_put`` to HBM; XLA's latency-hiding scheduler overlaps the
+  host->HBM DMA with compute, which is the compiled analogue of the
+  reference's ``prefetch_bucket`` pipeline. Updated params stream back out
+  through host-kind ``out_shardings``.
+- ``"eager"`` mode: some backends cannot partition the in-jit placement
+  annotations under SPMD (the CPU emulation mesh among them) — there the
+  engine swaps eagerly around each compiled call: async ``device_put`` of
+  the host store to HBM before the step, updated params put back after,
+  the transient device copy freed on return. Same residency contract,
+  coarser overlap. The mode is chosen by compile-probing the actual mesh.
+- Leaves smaller than ``stage3_param_persistence_threshold`` stay resident
+  in HBM (the persistence contract of reference
+  ``parameter_offload.py:242`` — small params are never worth a round trip).
+
+The device-memory contract matches the reference: HBM holds only transient
+compute copies of the large parameters during a step, never the persistent
+fp32 master set.
+"""
+
+from typing import Any, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+from ...utils.logging import log_dist
+
+_HOST_KIND = "pinned_host"
+
+
+def host_memory_supported() -> bool:
+    """Whether the backend exposes a pinned-host memory space."""
+    try:
+        kinds = {m.kind for m in jax.devices()[0].addressable_memories()}
+    except Exception:
+        return False
+    return _HOST_KIND in kinds
+
+
+def plan_param_store_shardings(param_shardings, param_shapes, threshold: int) -> Tuple[Any, int, int]:
+    """Host-kind shardings for large leaves; returns (tree, n_offloaded, bytes_offloaded)."""
+    stats = {"n": 0, "bytes": 0}
+
+    def leaf(shard: NamedSharding, shape) -> NamedSharding:
+        size = int(np.prod(shape.shape)) if shape.shape else 1
+        if size < threshold:
+            return shard  # persistent in HBM, like sub-threshold params in the reference
+        stats["n"] += 1
+        stats["bytes"] += size * 4  # fp32 master
+        return NamedSharding(shard.mesh, shard.spec, memory_kind=_HOST_KIND)
+
+    tree = jax.tree_util.tree_map(leaf, param_shardings, param_shapes)
+    return tree, stats["n"], stats["bytes"]
+
+
+def fetch_params(params, store_shardings):
+    """In-jit transfer of offloaded leaves to device memory.
+
+    Traced under ``jit``: each host-kind leaf becomes a host->HBM stream
+    scheduled by XLA; device-resident leaves pass through untouched.
+    """
+
+    def leaf(p, shard):
+        if getattr(shard, "memory_kind", None) == _HOST_KIND:
+            return jax.device_put(p, NamedSharding(shard.mesh, shard.spec, memory_kind="device"))
+        return p
+
+    return jax.tree_util.tree_map(leaf, params, store_shardings)
+
+
+def probe_jit_streaming(mesh) -> bool:
+    """Whether in-jit memory-kind transfers compile on this mesh.
+
+    XLA:TPU partitions ``annotate_device_placement`` fine; the CPU SPMD
+    emulation rejects it on >1-device meshes ("Side-effect ops cannot be
+    replicated") — probe once with a tiny roundtrip instead of guessing
+    by platform.
+    """
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    host = NamedSharding(mesh, P(), memory_kind=_HOST_KIND)
+    dev = NamedSharding(mesh, P(), memory_kind="device")
+    try:
+        x = jax.device_put(jnp.zeros((4,), jnp.float32), host)
+        fn = jax.jit(lambda a: jax.device_put(a, dev) * 2, out_shardings=host)
+        fn.lower(x).compile()
+        return True
+    except Exception:
+        return False
+
+
+def maybe_enable_param_offload(config, topology, param_shardings, param_shapes):
+    """Decide + plan param offload for the engine.
+
+    Returns ``(store_shardings, mode)`` where mode is ``False`` (disabled),
+    ``"jit"`` (in-jit streaming) or ``"eager"`` (engine-level swap).
+    Falls back (with a logged reason) instead of erroring, mirroring the
+    reference's behaviour of validating offload config against the stage.
+    """
+    off = config.zero_config.offload_param
+    if off.device not in ("cpu", "nvme"):
+        return param_shardings, False
+    if config.zero_config.stage != 3:
+        log_dist(f"offload_param.device={off.device} requires ZeRO stage 3 (got stage "
+                 f"{config.zero_config.stage}) — parameters stay in device memory", ranks=[0])
+        return param_shardings, False
+    if not host_memory_supported():
+        log_dist("offload_param: backend exposes no pinned_host memory space — "
+                 "parameters stay in device memory", ranks=[0])
+        return param_shardings, False
+    if config.eigenvalue.enabled:
+        log_dist("offload_param: eigenvalue pass does host-side math on the live params — "
+                 "parameters stay in device memory", ranks=[0])
+        return param_shardings, False
+
+    threshold = config.zero_config.stage3_param_persistence_threshold
+    store, n, nbytes = plan_param_store_shardings(param_shardings, param_shapes, threshold)
+    if n == 0:
+        log_dist("offload_param: every parameter is below stage3_param_persistence_threshold "
+                 f"({threshold}) — nothing to offload", ranks=[0])
+        return param_shardings, False
+    mode = "jit" if probe_jit_streaming(topology.mesh) else "eager"
+    log_dist(f"ZeRO-3 param offload ({off.device}, {mode} streaming): {n} leaves / "
+             f"{nbytes / 1e6:.1f} MB fp32 master held in pinned host memory, streamed to HBM "
+             f"per step (persistence threshold {threshold})", ranks=[0])
+    return store, mode
