@@ -87,6 +87,98 @@ def attention_xla(q: jnp.ndarray,
     return out.astype(orig_dtype)
 
 
+@register_op("attention", "chunked", priority=-1)
+def attention_chunked(q: jnp.ndarray,
+                      k: jnp.ndarray,
+                      v: jnp.ndarray,
+                      *,
+                      causal: bool = True,
+                      scale: Optional[float] = None,
+                      bias: Optional[jnp.ndarray] = None,
+                      segment_ids: Optional[jnp.ndarray] = None,
+                      kv_len=None,
+                      window: Optional[int] = None,
+                      alibi_slopes: Optional[jnp.ndarray] = None,
+                      chunk: int = 512) -> jnp.ndarray:
+    """Online-softmax attention over KV chunks — O(S·chunk) peak memory.
+
+    The pure-XLA analogue of the flash kernel's memory behaviour (reference
+    fused softmax, ``csrc/transformer/inference/csrc/softmax.cu``): logits
+    never materialize as a full (B,H,Sq,Sk) block, only one (B,H,Sq,chunk)
+    tile per scan step, and the scan body is rematted so backward re-forms
+    each tile instead of saving them all. Numerically matches
+    :func:`attention_xla` (fp32 accumulation, same masking contract).
+
+    Used as the fallback for long sequences where the Pallas kernel is
+    unavailable, and by the AOT memory audit so CPU compiles reflect the
+    TPU kernel's memory profile rather than the quadratic XLA fallback.
+    """
+    if bias is not None or segment_ids is not None:
+        # rare paths (pair bias / packing): take the materializing oracle
+        return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids,
+                             kv_len=kv_len, window=window, alibi_slopes=alibi_slopes)
+    if window is not None and window < 1:
+        raise ValueError(f"window must be >= 1 (got {window}); pass None to disable the sliding window")
+    orig_dtype = q.dtype
+    n_rep = q.shape[2] // k.shape[2]
+    k = _repeat_kv(k, n_rep)
+    v = _repeat_kv(v, n_rep)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    c = min(chunk, sk)
+    n_chunks = -(-sk // c)
+    pad = n_chunks * c - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    valid = kv_len if kv_len is not None else sk
+    offset = valid - sq  # query absolute positions [valid - sq, valid)
+    qf = q.astype(jnp.float32) * scale
+    # no upcast of K: qf is fp32, so each tile's einsum promotes per chunk —
+    # a whole-sequence fp32 K copy would defeat the op's memory contract
+    kc = k.reshape(b, n_chunks, c, h, d).transpose(1, 0, 2, 3, 4)
+    vc = v.reshape(b, n_chunks, c, h, d).transpose(1, 0, 2, 3, 4)
+    qi = jnp.arange(sq, dtype=jnp.int32) + offset  # (sq,) absolute
+    sl = None if alibi_slopes is None else jax.lax.stop_gradient(
+        jnp.asarray(alibi_slopes, jnp.float32))
+
+    def body(carry, inp):
+        acc, m, denom = carry  # (b,h,sq,d) f32, (b,h,sq), (b,h,sq)
+        kcb, vcb, base = inp  # (b,c,h,d), (b,c,h,d), scalar chunk start
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kcb,
+                            preferred_element_type=jnp.float32)  # (b,h,sq,c)
+        ki = base + jnp.arange(c, dtype=jnp.int32)  # absolute key positions
+        if sl is not None:
+            logits = logits + sl[None, :, None, None] * ki.astype(jnp.float32)[None, None, None, :]
+        mask = (ki[None, :] < valid)  # (sq?,c) -> broadcast below
+        mask = jnp.broadcast_to(mask, (sq, c))
+        if causal:
+            mask = mask & (ki[None, :] <= qi[:, None])
+        if window is not None:
+            mask = mask & (ki[None, :] > qi[:, None] - window) & (ki[None, :] <= qi[:, None])
+        neg = jnp.finfo(jnp.float32).min
+        logits = jnp.where(mask[None, None], logits, neg)
+        m_chunk = jnp.max(logits, axis=-1)
+        m_new = jnp.maximum(m, m_chunk)
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(logits - m_new[..., None])
+        p = jnp.where(mask[None, None], p, 0.0)  # rows with no valid keys yet
+        acc = acc * alpha[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vcb.astype(jnp.float32))
+        denom = denom * alpha + jnp.sum(p, axis=-1)
+        return (acc, m_new, denom), None
+
+    init = (jnp.zeros((b, h, sq, d), jnp.float32),
+            jnp.full((b, h, sq), jnp.finfo(jnp.float32).min),
+            jnp.zeros((b, h, sq), jnp.float32))
+    bases = (jnp.arange(n_chunks, dtype=jnp.int32) * c)
+    # remat: backward re-forms each logits tile instead of stashing all of
+    # them (which would reconstruct the quadratic buffer this op avoids)
+    (acc, m, denom), _ = jax.lax.scan(jax.checkpoint(body), init, (kc, vc, bases))
+    out = acc / jnp.maximum(denom, 1e-30)[..., None]
+    return out.transpose(0, 2, 1, 3).astype(orig_dtype)
+
+
 def attention(q, k, v, **kwargs):
     """Dispatch through the kernel registry (Pallas flash on TPU, XLA otherwise)."""
     return get_op("attention")(q, k, v, **kwargs)

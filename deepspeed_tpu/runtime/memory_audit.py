@@ -67,13 +67,25 @@ def _tree_bytes_per_chip(shapes, shardings) -> int:
 
 def audit_train_step(model, ds_config: Dict, mesh_axes: Optional[Dict[str, int]] = None,
                      micro_bs: int = 1, seq: int = 2048,
-                     compute_dtype=jnp.bfloat16) -> MemoryAudit:
+                     compute_dtype=jnp.bfloat16, attention_impl: Optional[str] = "chunked") -> MemoryAudit:
     """Compile (never run) one fused train step with abstract inputs and
-    report XLA's per-chip memory analysis."""
-    ds_config = dict(ds_config) if not isinstance(ds_config, DeepSpeedConfig) else ds_config
-    if mesh_axes is not None and not isinstance(ds_config, DeepSpeedConfig):
-        ds_config["mesh"] = dict(mesh_axes)
-    config = ds_config if isinstance(ds_config, DeepSpeedConfig) else DeepSpeedConfig(ds_config)
+    report XLA's per-chip memory analysis.
+
+    ``attention_impl`` defaults to the chunked online-softmax op so a CPU
+    audit reflects the flash kernel's O(S) memory profile; the plain XLA
+    fallback would dominate temps with (B,H,S,S) logits blocks that never
+    exist on TPU. Pass ``None`` to audit whatever the registry selects.
+    """
+    if isinstance(ds_config, DeepSpeedConfig):
+        if mesh_axes is not None:
+            raise ValueError("mesh_axes cannot override an already-built DeepSpeedConfig — "
+                             "pass the mesh in the config, or pass the config as a dict")
+        config = ds_config
+    else:
+        ds_config = dict(ds_config)
+        if mesh_axes is not None:
+            ds_config["mesh"] = dict(mesh_axes)
+        config = DeepSpeedConfig(ds_config)
     topo = initialize_mesh(config.mesh, force=True)
     config.resolve_batch_sizes(topo.data_parallel_size)
 
@@ -114,7 +126,16 @@ def audit_train_step(model, ds_config: Dict, mesh_axes: Optional[Dict[str, int]]
                      out_shardings=(None, param_shardings, opt_shardings))
     abstract_params = jax.tree_util.tree_map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), param_shapes)
     abstract_opt = jax.tree_util.tree_map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), opt_state_shapes)
-    compiled = jitted.lower(abstract_params, abstract_opt, batch).compile()
+
+    from ..ops.registry import REGISTRY
+    prev = REGISTRY._forced.get("attention")
+    if attention_impl is not None:
+        REGISTRY.set_impl("attention", attention_impl)
+    try:
+        compiled = jitted.lower(abstract_params, abstract_opt, batch).compile()
+    finally:
+        if attention_impl is not None:
+            REGISTRY.set_impl("attention", prev)
 
     mem = compiled.memory_analysis()
     hlo = compiled.as_text()

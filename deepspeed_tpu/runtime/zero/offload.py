@@ -94,7 +94,9 @@ class HostOffloadOptimizer:
         gnorm = float(np.sqrt(sq))
         overflow = not np.isfinite(gnorm)
         if overflow:
-            return self._out_tree(shardings), gnorm, True
+            # no params materialize: the caller skips the step, so copying +
+            # device-putting the full master set here would be pure waste
+            return None, gnorm, True
         if grad_clip > 0.0:
             coef = min(1.0, grad_clip / (gnorm + 1e-6))
             if coef < 1.0:

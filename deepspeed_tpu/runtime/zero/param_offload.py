@@ -128,6 +128,10 @@ def maybe_enable_param_offload(config, topology, param_shardings, param_shapes):
                  "parameters stay in device memory", ranks=[0])
         return param_shardings, False
 
+    if off.device == "nvme" and config.zero_config.offload_optimizer.device != "nvme":
+        log_dist("offload_param.device=nvme: the disk-backed master store rides the host "
+                 "optimizer's NVMe swapper — without offload_optimizer.device=nvme the fp32 "
+                 "masters stay in PINNED HOST RAM (streamed like device=cpu), not on disk", ranks=[0])
     threshold = config.zero_config.stage3_param_persistence_threshold
     store, n, nbytes = plan_param_store_shardings(param_shardings, param_shapes, threshold)
     if n == 0:
