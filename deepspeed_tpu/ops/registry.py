@@ -37,12 +37,16 @@ class _Registry:
         impls.sort(key=lambda i: -i.priority)
         self._cache.pop(op_name, None)
 
-    def set_impl(self, op_name: str, impl_name: Optional[str]):
+    def set_impl(self, op_name: str, impl_name: Optional[str]) -> Optional[str]:
+        """Force-select an impl; returns the previously forced name (for
+        save/restore around scoped overrides)."""
+        prev = self._forced.get(op_name)
         if impl_name is None:
             self._forced.pop(op_name, None)
         else:
             self._forced[op_name] = impl_name
         self._cache.pop(op_name, None)
+        return prev
 
     def get(self, op_name: str) -> Callable:
         if op_name in self._cache:

@@ -128,9 +128,7 @@ def audit_train_step(model, ds_config: Dict, mesh_axes: Optional[Dict[str, int]]
     abstract_opt = jax.tree_util.tree_map(lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype), opt_state_shapes)
 
     from ..ops.registry import REGISTRY
-    prev = REGISTRY._forced.get("attention")
-    if attention_impl is not None:
-        REGISTRY.set_impl("attention", attention_impl)
+    prev = REGISTRY.set_impl("attention", attention_impl) if attention_impl is not None else None
     try:
         compiled = jitted.lower(abstract_params, abstract_opt, batch).compile()
     finally:

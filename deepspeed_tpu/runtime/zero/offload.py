@@ -82,6 +82,8 @@ class HostOffloadOptimizer:
              grad_clip: float = 0.0, shardings=None) -> Tuple[Any, float, bool]:
         """Step all parameters; returns (new_params_tree, grad_norm, overflow).
 
+        On overflow the step is skipped and ``new_params_tree`` is ``None``
+        (no copies, no transfers) — callers must keep their previous params.
         With ``shardings`` (a pytree of shardings matching the params), the
         returned tree is device-put leaf-by-leaf — at most one transient
         host copy per leaf, which keeps the NVMe-memmap path's RAM use at
