@@ -55,6 +55,12 @@ class TransformerConfig:
     embed_scale: bool = False  # gemma: scale embeddings by sqrt(d_model)
     rms_offset: bool = False  # gemma: rmsnorm weights stored zero-centered, applied as (1 + w)
     sliding_window: Optional[int] = None  # mistral: query i attends keys in (i - w, i]
+    # encoder family (BERT): bidirectional attention, post-LN blocks,
+    # token-type embeddings, MLM transform head (ref module_inject/containers/bert.py)
+    causal: bool = True  # False: bidirectional encoder
+    norm_scheme: str = "pre"  # pre (gpt/llama) | post (BERT: norm after residual add)
+    type_vocab_size: int = 0  # >0: token_type embeddings added to the input
+    mlm_head: bool = False  # BERT cls.predictions transform (dense+act+LN) before the tied decoder
     tie_embeddings: bool = True
     dtype: Any = jnp.float32  # activation/compute dtype
     norm_eps: float = 1e-5
@@ -246,7 +252,7 @@ class Attention(nn.Module):
             new_cache = (ck, cv, kv_len)
 
         slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
-        out = attention(q, k, v, causal=True, segment_ids=segment_ids, kv_len=kv_len,
+        out = attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids, kv_len=kv_len,
                         alibi_slopes=slopes, window=cfg.sliding_window)
         out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.use_attn_out_bias, name="o_proj",
                               dtype=cfg.dtype, param_dtype=jnp.float32)(out)
@@ -311,6 +317,10 @@ class Block(nn.Module):
         elif cfg.block_type == "parallel":  # gpt-neox use_parallel_residual
             a, new_cache = run_attn(make_norm(cfg)(x))
             x = x + a + self._mlp(cfg, make_norm(cfg)(x))
+        elif cfg.norm_scheme == "post":  # BERT: norm AFTER each residual add
+            a, new_cache = run_attn(x)
+            x = make_norm(cfg)(x + a)
+            x = make_norm(cfg)(x + self._mlp(cfg, x))
         else:
             a, new_cache = run_attn(make_norm(cfg)(x))
             x = x + a
@@ -325,7 +335,7 @@ class Transformer(nn.Module):
 
     @nn.compact
     def __call__(self, input_ids, positions=None, kv_caches=None, segment_ids=None, return_hidden=False,
-                 train=None, pld_theta=None):
+                 train=None, pld_theta=None, token_type_ids=None):
         cfg = self.cfg
         # decode (kv caches) implies inference; forward-only callers pass
         # train=False so eval/serving never drops MoE tokens
@@ -342,7 +352,12 @@ class Transformer(nn.Module):
         if cfg.pos_emb == "learned":
             wpe = self.param("wpe", nn.initializers.normal(0.02), (cfg.max_seq_len, cfg.d_model), jnp.float32)
             x = x + wpe[positions].astype(cfg.dtype)
-        if cfg.embedding_norm:  # bloom word_embeddings_layernorm
+        if cfg.type_vocab_size > 0:  # BERT segment embeddings
+            tte = self.param("type_emb", nn.initializers.normal(0.02),
+                             (cfg.type_vocab_size, cfg.d_model), jnp.float32)
+            tti = token_type_ids if token_type_ids is not None else jnp.zeros_like(input_ids)
+            x = x + tte[tti].astype(cfg.dtype)
+        if cfg.embedding_norm:  # bloom word_embeddings_layernorm / BERT embeddings.LayerNorm
             x = make_norm(cfg)(x)
 
         new_caches = [] if kv_caches is not None else None
@@ -367,13 +382,26 @@ class Transformer(nn.Module):
                         y = jnp.where(keep, y, x)
                     x = y
 
-        x = make_norm(cfg)(x)
+        if cfg.norm_scheme != "post":  # post-LN blocks already end normalized
+            x = make_norm(cfg)(x)
+        if cfg.mlm_head:
+            # BERT cls.predictions.transform: dense + act + LN before the
+            # tied decoder — part of the hidden pipeline so the fused-CE
+            # loss path projects the transformed hidden
+            x = nn.Dense(cfg.d_model, name="mlm_dense", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
+            x = nn.gelu(x, approximate=cfg.activation != "gelu_exact")
+            x = make_norm(cfg)(x)
+            # created unconditionally (not only on the logits path) so the
+            # param tree is identical between loss and logits calls
+            mlm_bias = self.param("mlm_bias", nn.initializers.zeros, (cfg.vocab_size,), jnp.float32)
         if return_hidden:
             # loss path: the head projection happens inside the fused CE
             # (ops/fused_ce.py) so full (B,S,V) logits never hit HBM
             return (x, new_caches) if kv_caches is not None else x
         if cfg.tie_embeddings:
             logits = jnp.einsum("bsd,vd->bsv", x, emb.astype(cfg.dtype))
+            if cfg.mlm_head:  # BERT cls.predictions.bias rides the tied decoder
+                logits = logits + mlm_bias.astype(cfg.dtype)
         else:
             logits = nn.Dense(cfg.vocab_size, use_bias=cfg.lm_head_bias, name="lm_head", dtype=cfg.dtype,
                               param_dtype=jnp.float32)(x)
@@ -432,10 +460,13 @@ class CausalLM:
         input_ids = batch["input_ids"]
         pld_theta = batch.get("pld_theta")  # injected by the engine when PLD is on
         extra = {}
+        if self.cfg.type_vocab_size > 0 and "token_type_ids" in batch:
+            extra["token_type_ids"] = batch["token_type_ids"]
         if pld_theta is not None:
             if rng is None:
                 raise ValueError("progressive layer drop needs the engine's step rng")
-            extra = {"pld_theta": pld_theta, "rngs": {"pld": rng}}
+            extra["pld_theta"] = pld_theta
+            extra["rngs"] = {"pld": rng}
         if self.cfg.moe_num_experts > 0:
             hidden, mods = self.module.apply({"params": params}, input_ids, return_hidden=True,
                                              mutable=["losses", "intermediates"], **extra)
@@ -445,7 +476,8 @@ class CausalLM:
             hidden = self.apply(params, input_ids, return_hidden=True, **extra)
             aux = 0.0
         if self.cfg.tie_embeddings:
-            w, vd, head_b = params["wte"].astype(self.cfg.dtype), True, None
+            w, vd = params["wte"].astype(self.cfg.dtype), True
+            head_b = params["mlm_bias"] if self.cfg.mlm_head else None
         else:
             w, vd = params["lm_head"]["kernel"].astype(self.cfg.dtype), False
             head_b = params["lm_head"]["bias"] if self.cfg.lm_head_bias else None

@@ -407,6 +407,29 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             dtype=dtype,
         )
+    elif model_type == "bert":
+        # encoder family: bidirectional post-LN blocks, segment embeddings,
+        # MLM transform head (ref module_inject/containers/bert.py,
+        # replace_policy.py HFBertLayerPolicy)
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("num_hidden_layers", 12),
+            n_heads=hf.get("num_attention_heads", 12),
+            d_model=hf.get("hidden_size", 768),
+            d_ff=hf.get("intermediate_size", 3072),
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=_map_gelu(hf.get("hidden_act", "gelu")),
+            pos_emb="learned",
+            causal=False,
+            norm_scheme="post",
+            embedding_norm=True,
+            type_vocab_size=hf.get("type_vocab_size", 2),
+            mlm_head=True,
+            tie_embeddings=True,
+            norm_eps=hf.get("layer_norm_eps", 1e-12),
+            dtype=dtype,
+        )
     elif model_type == "bloom":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -831,6 +854,56 @@ def convert_gpt_bigcode(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Di
     return params
 
 
+def convert_bert(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``BertForMaskedLM`` -> encoder param pytree.
+
+    Post-LN block: ``attention.output.LayerNorm`` / ``output.LayerNorm``
+    are the two in-block norms; ``cls.predictions.transform`` is the MLM
+    head whose decoder ties to the word embeddings
+    (ref ``module_inject/containers/bert.py``, ``HFBertLayerPolicy``).
+    """
+    sd = _strip_prefix(sd, prefixes=("bert.",))
+    H, D = cfg.n_heads, cfg.head_dim
+    dm = cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["embeddings.word_embeddings.weight"],
+        "wpe": sd["embeddings.position_embeddings.weight"][:cfg.max_seq_len],
+        "type_emb": sd["embeddings.token_type_embeddings.weight"],
+        ln(0): {"scale": sd["embeddings.LayerNorm.weight"], "bias": sd["embeddings.LayerNorm.bias"]},
+        "mlm_dense": {"kernel": sd["cls.predictions.transform.dense.weight"].T,
+                      "bias": sd["cls.predictions.transform.dense.bias"]},
+        ln(1): {"scale": sd["cls.predictions.transform.LayerNorm.weight"],
+                "bias": sd["cls.predictions.transform.LayerNorm.bias"]},
+        "mlm_bias": sd["cls.predictions.bias"],
+    }
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}."
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "attention.output.LayerNorm.weight"],
+                    "bias": sd[p + "attention.output.LayerNorm.bias"]},
+            ln(1): {"scale": sd[p + "output.LayerNorm.weight"],
+                    "bias": sd[p + "output.LayerNorm.bias"]},
+            "attn": {
+                "q_proj": {"kernel": sd[p + "attention.self.query.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "attention.self.query.bias"].reshape(H, D)},
+                "k_proj": {"kernel": sd[p + "attention.self.key.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "attention.self.key.bias"].reshape(H, D)},
+                "v_proj": {"kernel": sd[p + "attention.self.value.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "attention.self.value.bias"].reshape(H, D)},
+                "o_proj": {"kernel": sd[p + "attention.output.dense.weight"].T.reshape(H, D, dm),
+                           "bias": sd[p + "attention.output.dense.bias"]},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "intermediate.dense.weight"].T,
+                            "bias": sd[p + "intermediate.dense.bias"]},
+                "down_proj": {"kernel": sd[p + "output.dense.weight"].T,
+                              "bias": sd[p + "output.dense.bias"]},
+            },
+        }
+    return params
+
+
 def convert_bloom(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     """HF ``BloomForCausalLM`` -> pytree: ALiBi attention, embedding
     layernorm, per-head-interleaved fused qkv (H, 3, D)."""
@@ -876,6 +949,7 @@ _CONVERTERS = {
     "bloom": convert_bloom,
     "gpt_bigcode": convert_gpt_bigcode,
     "phi3": convert_phi3,
+    "bert": convert_bert,
 }
 
 

@@ -2,11 +2,12 @@
 
 Capability parity: reference ``csrc/deepspeed4science/evoformer_attn/``
 (``DS4Sci_EvoformerAttention`` — cutlass fused attention with additive
-bias terms, used by AlphaFold-style MSA-row/column and triangle
-attention). The TPU shape: the bias-add folds into the attention logits
-and XLA fuses the whole block; the heavy lifting (QK^T, softmax, PV) is
-the same MXU pipeline as regular attention, so the ~15k LoC of cutlass
-template mass reduces to a thin op over the shared attention kernel.
+bias terms + dbias backward, used by AlphaFold-style MSA-row/column and
+triangle attention). The TPU shape: the Pallas flash kernel takes the
+summed additive bias natively (fwd tile add + in-kernel dbias in the
+backward pass — ``ops/pallas/flash_attention.py``), so the probability
+matrix never materializes in HBM, exactly the reference kernel's
+contract. A jnp einsum+softmax path remains as the non-TPU fallback.
 
 API mirrors the reference binding: ``q/k/v`` are
 ``(*batch_dims, S, H, D)`` and ``biases`` is a list of arrays
@@ -21,14 +22,9 @@ import jax
 import jax.numpy as jnp
 
 
-def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                        biases: Sequence[jnp.ndarray] = (), scale: Optional[float] = None) -> jnp.ndarray:
-    """Bias-augmented (non-causal) attention over arbitrary leading dims.
-
-    Reference ``DS4Sci_EvoformerAttention(q, k, v, [bias_1, bias_2])``.
-    """
-    *lead, Sq, H, D = q.shape
-    Sk = k.shape[-3]
+def _evoformer_xla(q, k, v, biases=(), scale=None):
+    """Fallback: materializing einsum+softmax (autodiff backward)."""
+    D = q.shape[-1]
     scale = scale if scale is not None else 1.0 / (D ** 0.5)
     logits = jnp.einsum("...qhd,...khd->...hqk", q, k, preferred_element_type=jnp.float32) * scale
     for b in biases:
@@ -36,6 +32,57 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("...hqk,...khd->...qhd", probs.astype(v.dtype), v)
     return out.astype(q.dtype)
+
+
+def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        biases: Sequence[jnp.ndarray] = (), scale: Optional[float] = None,
+                        interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Bias-augmented (non-causal) attention over arbitrary leading dims.
+
+    Reference ``DS4Sci_EvoformerAttention(q, k, v, [bias_1, bias_2])``.
+    Routes through the Pallas flash kernel (additive-bias + dbias support)
+    on TPU — ``interpret=True`` forces the kernel's interpreter on CPU;
+    ``interpret=False`` forces the jnp fallback.
+    """
+    from .registry import pallas_available
+
+    *lead, Sq, H, D = q.shape
+    Sk = k.shape[-3]
+    use_kernel = pallas_available() if interpret is None else True
+    if interpret is False:
+        use_kernel = False
+    if use_kernel and biases:
+        # the kernel reads one summed (prod(lead), H, Sq, Sk) fp32 bias:
+        # broadcast lead dims (e.g. MSA rows) expand in HBM. Guard huge
+        # expansions behind the O(S·chunk) fallback until the kernel grows
+        # collapsed-bias index maps + accumulated dbias
+        lead_n = 1
+        for d in lead:
+            lead_n *= d
+        if lead_n * H * Sq * Sk * 4 > int(2e9):
+            use_kernel = False
+    if not use_kernel:
+        return _evoformer_xla(q, k, v, biases, scale)
+
+    from .pallas.flash_attention import flash_attention
+
+    B = 1
+    for d in lead:
+        B *= d
+    qf = q.reshape(B, Sq, H, D)
+    kf = k.reshape(B, Sk, H, D)
+    vf = v.reshape(B, Sk, H, D)
+    bias = None
+    if biases:
+        # sum in the broadcast space, then flatten the leading dims —
+        # broadcasting happens under autodiff so dbias reduces correctly
+        total = biases[0].astype(jnp.float32)
+        for b in biases[1:]:
+            total = total + b.astype(jnp.float32)
+        bias = jnp.broadcast_to(total, (*lead, H, Sq, Sk)).reshape(B, H, Sq, Sk)
+    out = flash_attention(qf, kf, vf, causal=False, scale=scale, bias=bias,
+                          interpret=bool(interpret))
+    return out.reshape(*lead, Sq, H, D).astype(q.dtype)
 
 
 # torch-binding-compatible alias (reference evoformer_attn/attention.py)

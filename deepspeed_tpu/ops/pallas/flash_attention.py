@@ -57,14 +57,18 @@ def _blk(seq: int, want: int) -> int:
 
 
 
-def _scores(q, k, slope, row0, col0, bq, bk, scale, causal, has_alibi, window):
+def _scores(q, k, slope, row0, col0, bq, bk, scale, causal, has_alibi, window, btile=None):
     """(bq, bk) fp32 masked scores — the ONE definition of the mask/bias
     math; fwd and both bwd kernels recompute s through this so they can
-    never drift apart."""
+    never drift apart. ``btile``: additive bias tile (evoformer pair/mask
+    bias, reference DS4Sci_EvoformerAttention) — added before masking so
+    masked entries stay exactly NEG_INF."""
     s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32) * scale
     cols = col0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     if has_alibi:  # shift-invariant ALiBi: slope * key_position
         s = s + slope * cols.astype(jnp.float32)
+    if btile is not None:
+        s = s + btile.astype(jnp.float32)
     if causal:  # window implies causal (non-causal windows fall back to XLA)
         rows = row0 + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
         mask = cols <= rows
@@ -77,8 +81,8 @@ def _scores(q, k, slope, row0, col0, bq, bk, scale, causal, has_alibi, window):
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
-def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int, seq_k: int,
-                scale: float, causal: bool, has_alibi: bool, window: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, bias_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int,
+                seq_k: int, scale: float, causal: bool, has_alibi: bool, window: int, has_bias: bool):
     qi = pl.program_id(1)
     q = q_ref[0]  # (bq, D) input dtype — MXU runs bf16 operands w/ fp32 accumulation
     D = q.shape[-1]
@@ -100,7 +104,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk:
         acc, m, l = carry
         k = k_ref[0, pl.dslice(j * bk, bk), :]  # (bk, D)
         v = v_ref[0, pl.dslice(j * bk, bk), :]
-        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window)
+        btile = bias_ref[0, :, pl.dslice(j * bk, bk)] if has_bias else None
+        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window, btile)
         bmax = jnp.max(s, axis=-1)
         new_m = jnp.maximum(m, bmax)
         p = jnp.exp(s - new_m[:, None])
@@ -123,12 +128,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, bq: int, bk:
     lse_ref[0] = jax.lax.broadcast_in_dim(lse, (lse.shape[0], LANES), (0,))
 
 
-def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool, window: int):
+def _flash_fwd(q, k, v, slopes, bias, scale: float, causal: bool, interpret: bool, has_alibi: bool,
+               window: int, has_bias: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                               has_alibi=has_alibi, window=window)
+                               has_alibi=has_alibi, window=window, has_bias=has_bias)
+    # without bias a (1,1,LANES) dummy rides along so the kernel arity is fixed
+    bias_spec = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
+                 else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // bq),
@@ -137,6 +146,7 @@ def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
+            bias_spec,
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
@@ -147,15 +157,15 @@ def _flash_fwd(q, k, v, slopes, scale: float, causal: bool, interpret: bool, has
             jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, slopes)
+    )(q, k, v, slopes, bias)
     return o, lse
 
 
 # ----------------------------------------------------------------------
 # backward
 # ----------------------------------------------------------------------
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_ref, *, bq, bk, seq_q, seq_k,
-               scale, causal, has_alibi, window):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias_ref, dq_ref, dbias_ref, *,
+               bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, has_bias):
     qi = pl.program_id(1)
     slope = slopes_ref[0, 0]
     q = q_ref[0]
@@ -171,23 +181,31 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
         nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), nk)
     if window > 0:
         j0 = jnp.maximum(offset + qi * bq - window + 1, 0) // bk
+    if has_bias:
+        # blocks the loop skips contribute zero dbias; clear the whole row
+        # band first so skipped tiles don't hold stale VMEM contents
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
 
     def body(j, dq):
         k = k_ref[0, pl.dslice(j * bk, bk), :]
         v = v_ref[0, pl.dslice(j * bk, bk), :]
-        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window)
+        btile = bias_ref[0, :, pl.dslice(j * bk, bk)] if has_bias else None
+        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window, btile)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)  # (bq, bk)
-        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        dlogits = p * (dp - delta[:, None])
+        if has_bias:  # dbias = dlogits (bias enters the logits additively, unscaled)
+            dbias_ref[0, :, pl.dslice(j * bk, bk)] = dlogits.astype(dbias_ref.dtype)
+        ds = (dlogits * scale).astype(k.dtype)
         return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
 
     dq = jax.lax.fori_loop(j0, nk, body, jnp.zeros((bq, D), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_ref, dv_ref, *, bq, bk, seq_q,
-                seq_k, scale, causal, has_alibi, window):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias_ref, dk_ref, dv_ref, *,
+                bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, has_bias):
     kj = pl.program_id(1)
     slope = slopes_ref[0, 0]
     k = k_ref[0]
@@ -212,7 +230,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_
         do = do_ref[0, pl.dslice(i * bq, bq), :]
         lse = lse_ref[0, pl.dslice(i * bq, bq), 0]
         delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
-        s = _scores(q, k, slope, offset + i * bq, kj * bk, bq, bk, scale, causal, has_alibi, window)
+        btile = bias_ref[0, pl.dslice(i * bq, bq), :] if has_bias else None
+        s = _scores(q, k, slope, offset + i * bq, kj * bk, bq, bk, scale, causal, has_alibi, window, btile)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
         pc = p.astype(do.dtype)
@@ -229,17 +248,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dk_
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpret: bool, has_alibi: bool,
-               window: int):
+def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, interpret: bool,
+               has_alibi: bool, window: int, has_bias: bool):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (BH, Sq)
     delta = jnp.broadcast_to(delta[..., None], (BH, Sq, LANES))
 
-    dq = pl.pallas_call(
+    bias_spec_q = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
+                   else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
+    bias_spec_k = (pl.BlockSpec((1, Sq, bk), lambda b, j: (b, 0, j)) if has_bias
+                   else pl.BlockSpec((1, 1, LANES), lambda b, j: (0, 0, 0)))
+    dbias_shape = (BH, Sq, Sk) if has_bias else (1, 1, LANES)
+    dbias_spec = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
+                  else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
+
+    dq, dbias = pl.pallas_call(
         functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                          has_alibi=has_alibi, window=window),
+                          has_alibi=has_alibi, window=window, has_bias=has_bias),
         grid=(BH, Sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
@@ -249,15 +276,22 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpre
             pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
+            bias_spec_q,
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            dbias_spec,
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+            jax.ShapeDtypeStruct(dbias_shape, jnp.float32),
+        ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, slopes)
+    )(q, k, v, do, lse, delta, slopes, bias)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                          has_alibi=has_alibi, window=window),
+                          has_alibi=has_alibi, window=window, has_bias=has_bias),
         grid=(BH, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
@@ -267,6 +301,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpre
             pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, LANES), lambda b, j: (b, 0)),
+            bias_spec_k,
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
@@ -277,16 +312,16 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, scale: float, causal: bool, interpre
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta, slopes)
-    return dq, dk, dv
+    )(q, k, v, do, lse, delta, slopes, bias)
+    return dq, dk, dv, dbias
 
 
 # ----------------------------------------------------------------------
 # public op: (B, S, H, D) layout + GQA + custom_vjp
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q, k, v, slopes, scale, causal, interpret, has_alibi, window):
-    o, _ = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias):
+    o, _ = _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias)
     return o
 
 
@@ -296,29 +331,41 @@ def _bh_slopes(slopes, B, H):
     return jnp.broadcast_to(flat[:, None], (B * H, LANES))
 
 
-def _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi, window):
+def _bh_bias(bias, B, H, Sq, Sk, has_bias):
+    """(B, H, Sq, Sk) additive bias -> (B*H, Sq, Sk); dummy when disabled."""
+    if not has_bias:
+        return jnp.zeros((1, 1, LANES), jnp.float32)
+    return jnp.asarray(bias, jnp.float32).reshape(B * H, Sq, Sk)
+
+
+def _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias):
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
-    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), _bh_slopes(slopes, B, H), scale, causal, interpret,
-                        has_alibi, window)
+    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), _bh_slopes(slopes, B, H),
+                        _bh_bias(bias, B, H, Sq, Sk, has_bias), scale, causal, interpret,
+                        has_alibi, window, has_bias)
     o = o.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     return o, lse
 
 
-def _flash_vjp_fwd(q, k, v, slopes, scale, causal, interpret, has_alibi, window):
-    o, lse = _flash_core(q, k, v, slopes, scale, causal, interpret, has_alibi, window)
-    return o, (q, k, v, slopes, o, lse)
+def _flash_vjp_fwd(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias):
+    o, lse = _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias)
+    return o, (q, k, v, slopes, bias, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, interpret, has_alibi, window, res, do):
-    q, k, v, slopes, o, lse = res
+def _flash_vjp_bwd(scale, causal, interpret, has_alibi, window, has_bias, res, do):
+    q, k, v, slopes, bias, o, lse = res
     B, Sq, H, D = q.shape
+    Sk = k.shape[1]
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
-    dq, dk, dv = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do),
-                            _bh_slopes(slopes, B, H), scale, causal, interpret, has_alibi, window)
+    dq, dk, dv, dbias = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do),
+                                   _bh_slopes(slopes, B, H), _bh_bias(bias, B, H, Sq, Sk, has_bias),
+                                   scale, causal, interpret, has_alibi, window, has_bias)
     back = lambda x, S: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
-    return back(dq, Sq), back(dk, k.shape[1]), back(dv, k.shape[1]), jnp.zeros_like(slopes)
+    dbias_out = (dbias.reshape(B, H, Sq, Sk).astype(bias.dtype) if has_bias
+                 else jnp.zeros_like(bias))
+    return (back(dq, Sq), back(dk, Sk), back(dv, Sk), jnp.zeros_like(slopes), dbias_out)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -326,11 +373,11 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None, bias=None, segment_ids=None,
                     kv_len=None, window=None, alibi_slopes=None, interpret: bool = False):
-    """Drop-in for ``attention_xla`` on the fast path; handles ALiBi and
-    causal sliding windows natively (slope / band mask in-kernel with block
-    skipping) and falls back to XLA for features the kernel doesn't cover
-    (arbitrary bias, segments, padded kv, non-causal windows)."""
-    if bias is not None or segment_ids is not None or kv_len is not None or (
+    """Drop-in for ``attention_xla`` on the fast path; handles ALiBi,
+    causal sliding windows, and additive bias (evoformer pair/mask bias,
+    with in-kernel dbias) natively, and falls back to XLA for the rest
+    (segments, padded kv, non-causal windows)."""
+    if segment_ids is not None or kv_len is not None or (
             alibi_slopes is not None and not causal) or (window is not None and not causal):
         from ..attention import attention_xla
 
@@ -346,7 +393,16 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = No
         raise ValueError(f"window must be >= 1 (got {window}); pass None to disable the sliding window")
     has_alibi = alibi_slopes is not None
     slopes = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else jnp.zeros((q.shape[2],), jnp.float32)
-    return _flash(q, k, v, slopes, scale, causal, interpret, has_alibi, int(window or 0))
+    has_bias = bias is not None
+    B, Sq, H, _ = q.shape
+    Sk = k.shape[1]
+    if has_bias:
+        # broadcast OUTSIDE the custom_vjp: its transpose sums dbias back
+        # over the broadcast dims (e.g. an MSA mask bias (B,1,1,Sk))
+        bias = jnp.broadcast_to(bias, (B, H, Sq, Sk))
+    else:
+        bias = jnp.zeros((1, 1, LANES), jnp.float32)
+    return _flash(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, int(window or 0), has_bias)
 
 
 REGISTRY.register("attention", "pallas", flash_attention, is_available=pallas_available, priority=10)
