@@ -389,7 +389,11 @@ class Transformer(nn.Module):
             # tied decoder — part of the hidden pipeline so the fused-CE
             # loss path projects the transformed hidden
             x = nn.Dense(cfg.d_model, name="mlm_dense", dtype=cfg.dtype, param_dtype=jnp.float32)(x)
-            x = nn.gelu(x, approximate=cfg.activation != "gelu_exact")
+            # HF BertPredictionHeadTransform applies config.hidden_act
+            if cfg.activation == "relu":
+                x = nn.relu(x)
+            else:
+                x = nn.gelu(x, approximate=cfg.activation != "gelu_exact")
             x = make_norm(cfg)(x)
             # created unconditionally (not only on the logits path) so the
             # param tree is identical between loss and logits calls

@@ -113,8 +113,8 @@ def attention_chunked(q: jnp.ndarray,
     unavailable, and by the AOT memory audit so CPU compiles reflect the
     TPU kernel's memory profile rather than the quadratic XLA fallback.
     """
-    if bias is not None or segment_ids is not None:
-        # rare paths (pair bias / packing): take the materializing oracle
+    if segment_ids is not None:
+        # packing: take the materializing oracle
         return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids,
                              kv_len=kv_len, window=window, alibi_slopes=alibi_slopes)
     if window is not None and window < 1:
@@ -143,6 +143,14 @@ def attention_chunked(q: jnp.ndarray,
     sl = None if alibi_slopes is None else jax.lax.stop_gradient(
         jnp.asarray(alibi_slopes, jnp.float32))
 
+    if bias is not None:
+        # (B,H,Sq,Sk)-broadcastable additive bias, sliced per chunk inside
+        # the rematted body: a broadcast view fuses with the slice, so the
+        # expanded bias never materializes in the forward pass
+        bias_full = jnp.broadcast_to(bias, (b, h, sq, sk))
+        if pad:
+            bias_full = jnp.pad(bias_full, ((0, 0), (0, 0), (0, 0), (0, pad)))
+
     def body(carry, inp):
         acc, m, denom = carry  # (b,h,sq,d) f32, (b,h,sq), (b,h,sq)
         kcb, vcb, base = inp  # (b,c,h,d), (b,c,h,d), scalar chunk start
@@ -151,6 +159,8 @@ def attention_chunked(q: jnp.ndarray,
         ki = base + jnp.arange(c, dtype=jnp.int32)  # absolute key positions
         if sl is not None:
             logits = logits + sl[None, :, None, None] * ki.astype(jnp.float32)[None, None, None, :]
+        if bias is not None:
+            logits = logits + jax.lax.dynamic_slice_in_dim(bias_full, base, c, axis=3).astype(jnp.float32)
         mask = (ki[None, :] < valid)  # (sq?,c) -> broadcast below
         mask = jnp.broadcast_to(mask, (sq, c))
         if causal:

@@ -51,17 +51,29 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     use_kernel = pallas_available() if interpret is None else True
     if interpret is False:
         use_kernel = False
-    if use_kernel and biases:
+    lead_n = 1
+    for d in lead:
+        lead_n *= d
+    huge = bool(biases) and lead_n * H * Sq * Sk * 4 > int(2e9)
+    if use_kernel and huge:
         # the kernel reads one summed (prod(lead), H, Sq, Sk) fp32 bias:
-        # broadcast lead dims (e.g. MSA rows) expand in HBM. Guard huge
-        # expansions behind the O(S·chunk) fallback until the kernel grows
-        # collapsed-bias index maps + accumulated dbias
-        lead_n = 1
-        for d in lead:
-            lead_n *= d
-        if lead_n * H * Sq * Sk * 4 > int(2e9):
-            use_kernel = False
+        # broadcast lead dims (e.g. MSA rows) expand in HBM. Until the
+        # kernel grows collapsed-bias index maps + accumulated dbias, huge
+        # expansions take the chunked op, whose forward slices a broadcast
+        # view per KV chunk (never materialized; dbias in backward still
+        # expands — inherent to returning a full-bias gradient)
+        use_kernel = False
     if not use_kernel:
+        if huge:
+            from .attention import attention_chunked
+
+            total = biases[0].astype(jnp.float32)
+            for b in biases[1:]:
+                total = total + b.astype(jnp.float32)
+            bias = jnp.broadcast_to(total, (*lead, H, Sq, Sk)).reshape(lead_n, H, Sq, Sk)
+            out = attention_chunked(q.reshape(lead_n, Sq, H, D), k.reshape(lead_n, Sk, H, D),
+                                    v.reshape(lead_n, Sk, H, D), causal=False, scale=scale, bias=bias)
+            return out.reshape(*lead, Sq, H, D).astype(q.dtype)
         return _evoformer_xla(q, k, v, biases, scale)
 
     from .pallas.flash_attention import flash_attention

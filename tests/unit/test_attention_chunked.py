@@ -64,9 +64,15 @@ class TestChunkedParity:
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
-    def test_bias_falls_back_to_oracle(self):
-        q, k, v = _qkv(s=32)
-        bias = jax.random.normal(jax.random.PRNGKey(2), (1, 4, 32, 32))
+    def test_bias_native_chunking(self):
+        """Additive bias sliced per KV chunk (evoformer guarded path)."""
+        q, k, v = _qkv(s=64)
+        bias = jax.random.normal(jax.random.PRNGKey(2), (1, 4, 64, 64))
         np.testing.assert_allclose(
-            np.asarray(attention_chunked(q, k, v, bias=bias)),
+            np.asarray(attention_chunked(q, k, v, bias=bias, chunk=16)),
             np.asarray(attention_xla(q, k, v, bias=bias)), atol=3e-6)
+        # broadcast bias + grads (dbias reduces over the broadcast batch dim)
+        bb = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, 64))
+        g_ref = jax.grad(lambda b: attention_xla(q, k, v, bias=jnp.broadcast_to(b, (2, 4, 64, 64))).sum())(bb)
+        g = jax.grad(lambda b: attention_chunked(q, k, v, bias=b, chunk=16).sum())(bb)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
