@@ -4,10 +4,13 @@ Capability parity: reference ``csrc/deepspeed4science/evoformer_attn/``
 (``DS4Sci_EvoformerAttention`` — cutlass fused attention with additive
 bias terms + dbias backward, used by AlphaFold-style MSA-row/column and
 triangle attention). The TPU shape: the Pallas flash kernel takes the
-summed additive bias natively (fwd tile add + in-kernel dbias in the
-backward pass — ``ops/pallas/flash_attention.py``), so the probability
-matrix never materializes in HBM, exactly the reference kernel's
-contract. A jnp einsum+softmax path remains as the non-TPU fallback.
+summed additive bias natively (fwd tile add + in-kernel dbias —
+``ops/pallas/flash_attention.py``), with broadcast dims (MSA rows, heads,
+query rows) kept COLLAPSED in HBM: reads route shared blocks by index
+map and dbias accumulates in the bias's own shape, so neither the
+probability matrix nor an expanded bias ever materializes — the
+reference cutlass kernel's contract. A jnp einsum+softmax path remains
+as the non-TPU fallback.
 
 API mirrors the reference binding: ``q/k/v`` are
 ``(*batch_dims, S, H, D)`` and ``biases`` is a list of arrays
@@ -55,16 +58,11 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     for d in lead:
         lead_n *= d
     huge = bool(biases) and lead_n * H * Sq * Sk * 4 > int(2e9)
-    if use_kernel and huge:
-        # the kernel reads one summed (prod(lead), H, Sq, Sk) fp32 bias:
-        # broadcast lead dims (e.g. MSA rows) expand in HBM. Until the
-        # kernel grows collapsed-bias index maps + accumulated dbias, huge
-        # expansions take the chunked op, whose forward slices a broadcast
-        # view per KV chunk (never materialized; dbias in backward still
-        # expands — inherent to returning a full-bias gradient)
-        use_kernel = False
     if not use_kernel:
         if huge:
+            # the jnp path would materialize (lead, H, Sq, Sk) logits AND
+            # probs; the chunked op slices a broadcast bias view per KV
+            # chunk instead
             from .attention import attention_chunked
 
             total = biases[0].astype(jnp.float32)
@@ -85,15 +83,40 @@ def evoformer_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     kf = k.reshape(B, Sk, H, D)
     vf = v.reshape(B, Sk, H, D)
     bias = None
+    bias_repeat = 1
     if biases:
-        # sum in the broadcast space, then flatten the leading dims —
-        # broadcasting happens under autodiff so dbias reduces correctly
+        # sum in the (collapsed) broadcast space — jnp broadcasting aligns
+        # the mask (B,1,1,1,Sk) and pair (B,1,H,Sq,Sk) biases without
+        # expanding the MSA dim
         total = biases[0].astype(jnp.float32)
         for b in biases[1:]:
             total = total + b.astype(jnp.float32)
-        bias = jnp.broadcast_to(total, (*lead, H, Sq, Sk)).reshape(B, H, Sq, Sk)
+        tl = total.shape[:-3]  # lead dims of the summed bias
+        tl = (1,) * (len(lead) - len(tl)) + tuple(tl)
+        # broadcast lead dims stay collapsed when they form a full-prefix
+        # pattern (B, 1, ...): the kernel routes shared blocks by index map
+        # and accumulates dbias in this collapsed shape
+        split = len(tl)
+        while split > 0 and tl[split - 1] == 1:
+            split -= 1
+        prefix_ok = all(tl[i] == lead[i] for i in range(split))
+        if prefix_ok:
+            Bb = 1
+            for i in range(split):
+                Bb *= lead[i]
+            bias_repeat = B // Bb
+            bias = total.reshape(Bb, *total.shape[-3:])
+        elif huge:  # exotic broadcast layout at scale: chunked fallback
+            from .attention import attention_chunked
+
+            bias = jnp.broadcast_to(total, (*lead, H, Sq, Sk)).reshape(B, H, Sq, Sk)
+            out = attention_chunked(qf, kf, vf, causal=False, scale=scale, bias=bias)
+            return out.reshape(*lead, Sq, H, D).astype(q.dtype)
+        else:  # exotic broadcast layout: expand (rare, small)
+            bias = jnp.broadcast_to(total, (*lead, *total.shape[-3:])).reshape(
+                B, *total.shape[-3:])
     out = flash_attention(qf, kf, vf, causal=False, scale=scale, bias=bias,
-                          interpret=bool(interpret))
+                          bias_repeat=bias_repeat, interpret=bool(interpret))
     return out.reshape(*lead, Sq, H, D).astype(q.dtype)
 
 

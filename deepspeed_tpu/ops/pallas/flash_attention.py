@@ -81,6 +81,25 @@ def _scores(q, k, slope, row0, col0, bq, bk, scale, causal, has_alibi, window, b
 # ----------------------------------------------------------------------
 # forward
 # ----------------------------------------------------------------------
+def _bias_bh_fn(bias_meta, H: int):
+    """b = batch*H + head -> collapsed bias leading index.
+
+    ``bias_meta`` = (Bb, Hb, Sqb, repeat): the bias's own batch/head/row
+    sizes (each 1 or the full size) plus the lead-repeat factor (q batch
+    = Bb * repeat — e.g. evoformer MSA rows sharing one pair bias).
+    """
+    Bb, Hb, Sqb, repeat = bias_meta
+
+    def bias_bh(b):
+        batch = b // H
+        head = b % H
+        bb_idx = 0 if Bb == 1 else batch // repeat
+        h_idx = 0 if Hb == 1 else head
+        return bb_idx * Hb + h_idx
+
+    return bias_bh
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, bias_ref, o_ref, lse_ref, *, bq: int, bk: int, seq_q: int,
                 seq_k: int, scale: float, causal: bool, has_alibi: bool, window: int, has_bias: bool):
     qi = pl.program_id(1)
@@ -104,6 +123,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, bias_ref, o_ref, lse_ref, *, bq
         acc, m, l = carry
         k = k_ref[0, pl.dslice(j * bk, bk), :]  # (bk, D)
         v = v_ref[0, pl.dslice(j * bk, bk), :]
+        # sq-broadcast biases carry one row that broadcasts over the block
         btile = bias_ref[0, :, pl.dslice(j * bk, bk)] if has_bias else None
         s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window, btile)
         bmax = jnp.max(s, axis=-1)
@@ -129,15 +149,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, bias_ref, o_ref, lse_ref, *, bq
 
 
 def _flash_fwd(q, k, v, slopes, bias, scale: float, causal: bool, interpret: bool, has_alibi: bool,
-               window: int, has_bias: bool):
+               window: int, bias_meta, H: int):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    has_bias = bias_meta is not None
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     kernel = functools.partial(_fwd_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
                                has_alibi=has_alibi, window=window, has_bias=has_bias)
-    # without bias a (1,1,LANES) dummy rides along so the kernel arity is fixed
-    bias_spec = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
-                 else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
+    # without bias a (1,1,LANES) dummy rides along so the kernel arity is
+    # fixed; with bias, broadcast dims stay COLLAPSED in HBM and the index
+    # map routes every program to its shared block
+    if has_bias:
+        bias_bh = _bias_bh_fn(bias_meta, H)
+        sq_rows = 1 if bias_meta[2] == 1 else bq
+        bias_spec = pl.BlockSpec((1, sq_rows, Sk),
+                                 lambda b, i: (bias_bh(b), 0 if sq_rows == 1 else i, 0))
+    else:
+        bias_spec = pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0))
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, Sq // bq),
@@ -204,8 +232,60 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
+def _dq_kernel_collapsed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias_ref, dq_ref,
+                         dbias_ref, *, bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, sqb1: bool):
+    """dq + ACCUMULATED dbias for a collapsed (broadcast) bias.
+
+    Grid (n_bh, Sq//bq, n_rep) with the repeat dim innermost: every program
+    sharing one bias row visits the same dbias block consecutively, so the
+    block stays resident and read-modify-write accumulates — dbias never
+    expands past the bias's own (collapsed) shape in HBM. First visit
+    zeroes the block (``rep==0``, and ``qi==0`` too when rows broadcast).
+    """
+    qi = pl.program_id(1)
+    rep = pl.program_id(2)
+    slope = slopes_ref[0, 0]
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0, :, 0]
+    delta = delta_ref[0, :, 0]
+    D = q.shape[-1]
+
+    first = jnp.logical_and(qi == 0, rep == 0) if sqb1 else (rep == 0)
+
+    @pl.when(first)
+    def _zero():
+        dbias_ref[0] = jnp.zeros_like(dbias_ref[0])
+
+    offset = seq_k - seq_q
+    nk = seq_k // bk
+    j0 = 0
+    if causal:
+        nk = jnp.minimum(pl.cdiv(offset + (qi + 1) * bq, bk), nk)
+    if window > 0:
+        j0 = jnp.maximum(offset + qi * bq - window + 1, 0) // bk
+
+    def body(j, dq):
+        k = k_ref[0, pl.dslice(j * bk, bk), :]
+        v = v_ref[0, pl.dslice(j * bk, bk), :]
+        btile = bias_ref[0, :, pl.dslice(j * bk, bk)]
+        s = _scores(q, k, slope, offset + qi * bq, j * bk, bq, bk, scale, causal, has_alibi, window, btile)
+        p = jnp.exp(s - lse[:, None])
+        p = jnp.where(s <= NEG_INF, 0.0, p)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        dlogits = p * (dp - delta[:, None])
+        contrib = jnp.sum(dlogits, axis=0, keepdims=True) if sqb1 else dlogits
+        cur = dbias_ref[0, :, pl.dslice(j * bk, bk)]
+        dbias_ref[0, :, pl.dslice(j * bk, bk)] = cur + contrib.astype(dbias_ref.dtype)
+        ds = (dlogits * scale).astype(k.dtype)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(j0, nk, body, jnp.zeros((bq, D), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias_ref, dk_ref, dv_ref, *,
-                bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, has_bias):
+                bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, has_bias, sqb1: bool = False):
     kj = pl.program_id(1)
     slope = slopes_ref[0, 0]
     k = k_ref[0]
@@ -230,7 +310,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bia
         do = do_ref[0, pl.dslice(i * bq, bq), :]
         lse = lse_ref[0, pl.dslice(i * bq, bq), 0]
         delta = delta_ref[0, pl.dslice(i * bq, bq), 0]
-        btile = bias_ref[0, pl.dslice(i * bq, bq), :] if has_bias else None
+        if has_bias:
+            btile = bias_ref[0, :, :] if sqb1 else bias_ref[0, pl.dslice(i * bq, bq), :]
+        else:
+            btile = None
         s = _scores(q, k, slope, offset + i * bq, kj * bk, bq, bk, scale, causal, has_alibi, window, btile)
         p = jnp.exp(s - lse[:, None])
         p = jnp.where(s <= NEG_INF, 0.0, p)
@@ -249,49 +332,104 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bia
 
 
 def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, interpret: bool,
-               has_alibi: bool, window: int, has_bias: bool):
+               has_alibi: bool, window: int, bias_meta, H: int):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    has_bias = bias_meta is not None
     bq, bk = _blk(Sq, DEFAULT_BQ), _blk(Sk, DEFAULT_BK)
     delta = jnp.sum(o.astype(jnp.float32) * do.astype(jnp.float32), axis=-1)  # (BH, Sq)
     delta = jnp.broadcast_to(delta[..., None], (BH, Sq, LANES))
 
-    bias_spec_q = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
-                   else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
-    bias_spec_k = (pl.BlockSpec((1, Sq, bk), lambda b, j: (b, 0, j)) if has_bias
-                   else pl.BlockSpec((1, 1, LANES), lambda b, j: (0, 0, 0)))
-    dbias_shape = (BH, Sq, Sk) if has_bias else (1, 1, LANES)
-    dbias_spec = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
-                  else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
+    if has_bias:
+        Bb, Hb, Sqb, repeat = bias_meta
+        bias_bh = _bias_bh_fn(bias_meta, H)
+        sqb1 = Sqb == 1
+        n_bh = Bb * Hb
+        collapsed = n_bh < BH or sqb1
+        sq_rows = 1 if sqb1 else bq
+        bias_spec_q3 = pl.BlockSpec((1, sq_rows, Sk),
+                                    lambda bh, i, rep: (bh, 0 if sqb1 else i, 0))
+        bias_spec_q2 = pl.BlockSpec((1, sq_rows, Sk),
+                                    lambda b, i: (bias_bh(b), 0 if sqb1 else i, 0))
+        bias_spec_k = pl.BlockSpec((1, 1 if sqb1 else Sq, bk), lambda b, j: (bias_bh(b), 0, j))
+        dbias_shape = (n_bh, 1 if sqb1 else Sq, Sk)
+    else:
+        collapsed = False
+        bias_spec_q2 = pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0))
+        bias_spec_k = pl.BlockSpec((1, 1, LANES), lambda b, j: (0, 0, 0))
+        dbias_shape = (1, 1, LANES)
 
-    dq, dbias = pl.pallas_call(
-        functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                          has_alibi=has_alibi, window=window, has_bias=has_bias),
-        grid=(BH, Sq // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
-            bias_spec_q,
-        ],
-        out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            dbias_spec,
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct(dbias_shape, jnp.float32),
-        ],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta, slopes, bias)
+    if not collapsed:
+        # one dbias block per (b, i) program — plain tiled writes
+        dbias_spec = (pl.BlockSpec((1, bq, Sk), lambda b, i: (b, i, 0)) if has_bias
+                      else pl.BlockSpec((1, 1, LANES), lambda b, i: (0, 0, 0)))
+        dq, dbias = pl.pallas_call(
+            functools.partial(_dq_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
+                              has_alibi=has_alibi, window=window, has_bias=has_bias),
+            grid=(BH, Sq // bq),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
+                bias_spec_q2,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+                dbias_spec,
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+                jax.ShapeDtypeStruct(dbias_shape, jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta, slopes, bias)
+    else:
+        # broadcast bias: repeat dim innermost so every program sharing a
+        # bias row revisits its dbias block consecutively and accumulates
+        n_rep = BH // n_bh
+
+        def q_b(bh, rep):
+            if Bb == 1 and Hb == 1:
+                return rep
+            if Hb == 1:  # batch collapsed by `repeat`, heads all share
+                return (bh * repeat + rep // H) * H + rep % H
+            if Bb == 1:  # only heads distinct
+                return rep * H + bh
+            return ((bh // H) * repeat + rep) * H + bh % H
+
+        dq, dbias = pl.pallas_call(
+            functools.partial(_dq_kernel_collapsed, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale,
+                              causal=causal, has_alibi=has_alibi, window=window, sqb1=sqb1),
+            grid=(n_bh, Sq // bq, n_rep),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
+                pl.BlockSpec((1, Sk, D), lambda bh, i, rep: (q_b(bh, rep), 0, 0)),
+                pl.BlockSpec((1, Sk, D), lambda bh, i, rep: (q_b(bh, rep), 0, 0)),
+                pl.BlockSpec((1, bq, D), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
+                pl.BlockSpec((1, bq, LANES), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
+                pl.BlockSpec((1, LANES), lambda bh, i, rep: (q_b(bh, rep), 0)),
+                bias_spec_q3,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
+                pl.BlockSpec((1, sq_rows, Sk), lambda bh, i, rep: (bh, 0 if sqb1 else i, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
+                jax.ShapeDtypeStruct(dbias_shape, jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta, slopes, bias)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, bq=bq, bk=bk, seq_q=Sq, seq_k=Sk, scale=scale, causal=causal,
-                          has_alibi=has_alibi, window=window, has_bias=has_bias),
+                          has_alibi=has_alibi, window=window, has_bias=has_bias,
+                          sqb1=has_bias and bias_meta[2] == 1),
         grid=(BH, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
@@ -319,9 +457,9 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
 # ----------------------------------------------------------------------
 # public op: (B, S, H, D) layout + GQA + custom_vjp
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
-def _flash(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias):
-    o, _ = _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, bias_meta, H):
+    o, _ = _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, bias_meta, H)
     return o
 
 
@@ -331,40 +469,32 @@ def _bh_slopes(slopes, B, H):
     return jnp.broadcast_to(flat[:, None], (B * H, LANES))
 
 
-def _bh_bias(bias, B, H, Sq, Sk, has_bias):
-    """(B, H, Sq, Sk) additive bias -> (B*H, Sq, Sk); dummy when disabled."""
-    if not has_bias:
-        return jnp.zeros((1, 1, LANES), jnp.float32)
-    return jnp.asarray(bias, jnp.float32).reshape(B * H, Sq, Sk)
-
-
-def _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias):
-    B, Sq, H, D = q.shape
-    Sk = k.shape[1]
+def _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, bias_meta, H):
+    B, Sq, _, D = q.shape
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
-    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), _bh_slopes(slopes, B, H),
-                        _bh_bias(bias, B, H, Sq, Sk, has_bias), scale, causal, interpret,
-                        has_alibi, window, has_bias)
+    o, lse = _flash_fwd(to_bh(q), to_bh(k), to_bh(v), _bh_slopes(slopes, B, H), bias,
+                        scale, causal, interpret, has_alibi, window, bias_meta, H)
     o = o.reshape(B, H, Sq, D).transpose(0, 2, 1, 3)
     return o, lse
 
 
-def _flash_vjp_fwd(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias):
-    o, lse = _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, has_bias)
+def _flash_vjp_fwd(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, bias_meta, H):
+    o, lse = _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, bias_meta, H)
     return o, (q, k, v, slopes, bias, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, interpret, has_alibi, window, has_bias, res, do):
+def _flash_vjp_bwd(scale, causal, interpret, has_alibi, window, bias_meta, H, res, do):
     q, k, v, slopes, bias, o, lse = res
-    B, Sq, H, D = q.shape
+    B, Sq, _, D = q.shape
     Sk = k.shape[1]
     to_bh = lambda x: jnp.transpose(x, (0, 2, 1, 3)).reshape(B * H, x.shape[1], D)
     dq, dk, dv, dbias = _flash_bwd(to_bh(q), to_bh(k), to_bh(v), to_bh(o), lse, to_bh(do),
-                                   _bh_slopes(slopes, B, H), _bh_bias(bias, B, H, Sq, Sk, has_bias),
-                                   scale, causal, interpret, has_alibi, window, has_bias)
+                                   _bh_slopes(slopes, B, H), bias,
+                                   scale, causal, interpret, has_alibi, window, bias_meta, H)
     back = lambda x, S: x.reshape(B, H, S, D).transpose(0, 2, 1, 3)
-    dbias_out = (dbias.reshape(B, H, Sq, Sk).astype(bias.dtype) if has_bias
-                 else jnp.zeros_like(bias))
+    # cotangent matches the (collapsed, flat) bias argument; the outer
+    # 4D->flat reshape in flash_attention transposes automatically
+    dbias_out = dbias.astype(bias.dtype) if bias_meta is not None else jnp.zeros_like(bias)
     return (back(dq, Sq), back(dk, Sk), back(dv, Sk), jnp.zeros_like(slopes), dbias_out)
 
 
@@ -372,15 +502,29 @@ _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
 def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = None, bias=None, segment_ids=None,
-                    kv_len=None, window=None, alibi_slopes=None, interpret: bool = False):
+                    kv_len=None, window=None, alibi_slopes=None, interpret: bool = False,
+                    bias_repeat: int = 1):
     """Drop-in for ``attention_xla`` on the fast path; handles ALiBi,
-    causal sliding windows, and additive bias (evoformer pair/mask bias,
-    with in-kernel dbias) natively, and falls back to XLA for the rest
-    (segments, padded kv, non-causal windows)."""
+    causal sliding windows, and additive bias natively, and falls back to
+    XLA for the rest (segments, padded kv, non-causal windows).
+
+    ``bias``: additive logits bias broadcastable to ``(B, H, Sq, Sk)`` —
+    the batch/head/row dims may each be 1 and stay COLLAPSED in HBM (the
+    kernels route shared blocks by index map, and dbias accumulates in the
+    collapsed shape — reference evoformer_attn reads its ``(B,1,1,1,K)``
+    mask bias in place). ``bias_repeat``: the q batch is
+    ``bias.shape[0] * bias_repeat`` (consecutive q-batch groups share one
+    bias slice — evoformer MSA rows over one pair bias).
+    """
     if segment_ids is not None or kv_len is not None or (
             alibi_slopes is not None and not causal) or (window is not None and not causal):
         from ..attention import attention_xla
 
+        if bias is not None and bias_repeat != 1:
+            bias = jnp.asarray(bias)
+            while bias.ndim < 4:  # pad first so axis 0 is batch, not heads
+                bias = bias[None]
+            bias = jnp.repeat(bias, bias_repeat, axis=0)
         return attention_xla(q, k, v, causal=causal, scale=scale, bias=bias, segment_ids=segment_ids,
                              kv_len=kv_len, window=window, alibi_slopes=alibi_slopes)
     n_rep = q.shape[2] // k.shape[2]
@@ -393,16 +537,24 @@ def flash_attention(q, k, v, *, causal: bool = True, scale: Optional[float] = No
         raise ValueError(f"window must be >= 1 (got {window}); pass None to disable the sliding window")
     has_alibi = alibi_slopes is not None
     slopes = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else jnp.zeros((q.shape[2],), jnp.float32)
-    has_bias = bias is not None
     B, Sq, H, _ = q.shape
     Sk = k.shape[1]
-    if has_bias:
-        # broadcast OUTSIDE the custom_vjp: its transpose sums dbias back
-        # over the broadcast dims (e.g. an MSA mask bias (B,1,1,Sk))
-        bias = jnp.broadcast_to(bias, (B, H, Sq, Sk))
+    if bias is not None:
+        bias = jnp.asarray(bias, jnp.float32)
+        while bias.ndim < 4:
+            bias = bias[None]
+        Bb, Hb, Sqb, Skb = bias.shape
+        if (Skb != Sk or Sqb not in (1, Sq) or Hb not in (1, H)
+                or (Bb != 1 and Bb * bias_repeat != B)):
+            raise ValueError(f"bias shape {bias.shape} is not broadcastable to ({B},{H},{Sq},{Sk}) "
+                             f"with bias_repeat={bias_repeat}")
+        bias_meta = (Bb, Hb, Sqb, bias_repeat if Bb > 1 else 1)
+        bias_flat = bias.reshape(Bb * Hb, Sqb, Sk)
     else:
-        bias = jnp.zeros((1, 1, LANES), jnp.float32)
-    return _flash(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, int(window or 0), has_bias)
+        bias_meta = None
+        bias_flat = jnp.zeros((1, 1, LANES), jnp.float32)
+    return _flash(q, k, v, slopes, bias_flat, scale, causal, interpret, has_alibi, int(window or 0),
+                  bias_meta, H)
 
 
 REGISTRY.register("attention", "pallas", flash_attention, is_available=pallas_available, priority=10)

@@ -1,0 +1,130 @@
+"""Heavy end-to-end tests demoted from the fast tier.
+
+These five tests each compile one or more full engines (60-30s apiece on
+a 1-core box) and together consumed over half the fast tier's <2 min
+budget. They still run in the default suite; the fast tier keeps the
+quick unit-level coverage of the same modules.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.autotuning import Autotuner
+from test_autotuning import _tiny_setup  # tests/unit is on sys.path (conftest)
+
+
+def test_tune_end_to_end(tmp_path):
+    factory, batches = _tiny_setup()
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+        "autotuning": {"enabled": True, "tuner_type": "gridsearch", "results_dir": str(tmp_path)},
+    }
+    at = Autotuner(factory, base, batches, steps_per_trial=2, warmup_steps=1)
+    best = at.tune(stages=[0, 1], micro_batches=[1, 2])
+    assert best["zero_optimization"]["stage"] in (0, 1)
+    assert best["train_micro_batch_size_per_gpu"] in (1, 2)
+    assert "autotuning" not in best
+    assert len(at.records) == 4
+    assert all(r["throughput"] is not None for r in at.records)
+    path = at.write_results()
+    assert tmp_path.joinpath("autotuning_results.json").exists()
+
+
+def test_autotuner_records_memory_and_enforces_budget():
+    """Trials record compiled peak memory, and an impossible budget fails
+    every config (regression for throughput-only tuning picking configs
+    one batch from OOM)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+
+    rng = np.random.RandomState(0)
+    batches = [{"input_ids": rng.randint(0, 1024, size=(8, 16)).astype(np.int32)} for _ in range(4)]
+    base = {
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+        "autotuning": {"enabled": True},
+    }
+    tuner = Autotuner(lambda: CausalLM(gpt2_tiny()), base, batches, warmup_steps=1, steps_per_trial=1)
+    best = tuner.tune(stages=[0], micro_batches=[1])
+    assert best is not None
+    assert any(r.get("memory_bytes") for r in tuner.records), tuner.records
+
+    base_tight = dict(base, autotuning={"enabled": True, "max_memory_per_chip_gb": 1e-9})
+    tuner2 = Autotuner(lambda: CausalLM(gpt2_tiny()), base_tight, batches, warmup_steps=1, steps_per_trial=1)
+    with pytest.raises(RuntimeError, match="every experiment failed"):
+        tuner2.tune(stages=[0], micro_batches=[1])
+
+
+def test_engine_eigenvalue_wiring():
+    """engine.block_eigenvalue populates at the gas boundary when enabled."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.parallel.mesh import initialize_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 0},
+                "eigenvalue": {"enabled": True, "max_iter": 4, "tol": 1e-1}})
+    assert engine.eigenvalue is not None
+    batch = engine._put_batch({"input_ids": np.random.RandomState(0).randint(0, 1024, (8, 16)).astype(np.int32)})
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()
+    assert set(engine.block_eigenvalue) == {"layer_0", "layer_1"}
+    assert all(np.isfinite(v) for v in engine.block_eigenvalue.values())
+
+
+def test_shard_consistency_after_training_step():
+    """Replicated params stay bit-identical across devices after a real
+    engine step (the SPMD invariant)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+    from deepspeed_tpu.utils.debug import check_shard_consistency
+
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "steps_per_print": 10**9,
+    })
+    rng = np.random.RandomState(0)
+    loss = engine.forward({"input_ids": rng.randint(0, 1024, size=(8, 16)).astype(np.int32)})
+    engine.backward(loss)
+    engine.step()
+    assert check_shard_consistency(engine.params, "params") == []
+
+
+def test_pld_engine_trains_and_theta_decays():
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, gpt2_tiny
+
+    model = CausalLM(gpt2_tiny())
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "progressive_layer_drop": {"enabled": True, "theta": 0.5, "gamma": 0.1},
+        "steps_per_print": 10**9,
+    })
+    assert engine.progressive_layer_drop is not None
+    rng = np.random.RandomState(0)
+    thetas = []
+    for i in range(3):
+        loss = engine.forward({"input_ids": rng.randint(0, 1024, size=(8, 16)).astype(np.int32)})
+        engine.backward(loss)
+        engine.step()
+        thetas.append(engine.progressive_layer_drop.get_theta())
+        assert np.isfinite(float(loss))
+    assert thetas[0] > thetas[-1] > 0.5  # decaying toward theta

@@ -77,32 +77,6 @@ def test_autotuning_config_parses():
 
     c = AutotuningConfig.from_dict({"enabled": True, "metric": "latency"})
     assert c.enabled is True and c.metric == "latency"
-
-
-def test_engine_eigenvalue_wiring():
-    """engine.block_eigenvalue populates at the gas boundary when enabled."""
-    import deepspeed_tpu
-    from deepspeed_tpu.models import CausalLM, gpt2_tiny
-    from deepspeed_tpu.parallel.mesh import initialize_mesh
-    from deepspeed_tpu.runtime.config import MeshConfig
-
-    initialize_mesh(MeshConfig.from_dict({"data": 8}), force=True)
-    model = CausalLM(gpt2_tiny())
-    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model, model_parameters=params,
-        config={"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                "zero_optimization": {"stage": 0},
-                "eigenvalue": {"enabled": True, "max_iter": 4, "tol": 1e-1}})
-    assert engine.eigenvalue is not None
-    batch = engine._put_batch({"input_ids": np.random.RandomState(0).randint(0, 1024, (8, 16)).astype(np.int32)})
-    loss = engine.forward(batch)
-    engine.backward(loss)
-    engine.step()
-    assert set(engine.block_eigenvalue) == {"layer_0", "layer_1"}
-    assert all(np.isfinite(v) for v in engine.block_eigenvalue.values())
-
-
 def test_moe_token_mappings_shardings():
     from deepspeed_tpu.moe import drop_tokens, gather_tokens
     from deepspeed_tpu.parallel.mesh import initialize_mesh

@@ -556,3 +556,77 @@ class TestFlashBias:
                      argnums=(0, 1, 2, 3))(q, k, v, bias)
         for a, b in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+class TestFlashBiasCollapsed:
+    """Broadcast biases stay collapsed in HBM: index-mapped reads + dbias
+    accumulated in the bias's own shape (3D grid, repeat dim innermost)."""
+
+    def _qkv(self, B=4, S=32, H=2, D=8, seed=0):
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return (jax.random.normal(k1, (B, S, H, D)), jax.random.normal(k2, (B, S, H, D)),
+                jax.random.normal(k3, (B, S, H, D)))
+
+    @pytest.mark.parametrize("shape,label", [
+        ((1, 1, 1, 32), "mask-row"),         # fully collapsed (B,H,Sq all broadcast)
+        ((4, 1, 1, 32), "per-batch-mask"),   # H,Sq collapsed
+        ((1, 2, 32, 32), "shared-pair"),     # batch collapsed
+        ((4, 2, 32, 32), "full"),            # no collapse (2D-grid path)
+    ])
+    def test_fwd_and_dbias_match_oracle(self, shape, label):
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        bias = jax.random.normal(jax.random.PRNGKey(7), shape) * 0.5
+        full = jnp.broadcast_to(bias, (4, 2, 32, 32))
+        o_ref = attention_xla(q, k, v, causal=False, bias=full)
+        o = flash_attention(q, k, v, causal=False, bias=bias, interpret=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=3e-6, err_msg=label)
+        # dbias in the COLLAPSED shape must equal the reduced full-gradient
+        g_ref = jax.grad(lambda b: attention_xla(q, k, v, causal=False,
+                                                 bias=jnp.broadcast_to(b, (4, 2, 32, 32))).sum())(bias)
+        g = jax.grad(lambda b: flash_attention(q, k, v, causal=False, bias=b, interpret=True).sum())(bias)
+        assert g.shape == bias.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4, err_msg=label)
+
+    def test_bias_repeat_msa_rows(self):
+        """bias_repeat: consecutive q-batch groups (MSA rows) share one
+        bias slice; dbias sums over the repeat."""
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        B_outer, msa, S, H, D = 2, 3, 16, 2, 8
+        q, k, v = self._qkv(B=B_outer * msa, S=S, H=H, D=D, seed=1)
+        bias = jax.random.normal(jax.random.PRNGKey(9), (B_outer, H, S, S)) * 0.5
+        full = jnp.repeat(bias, msa, axis=0)
+        o_ref = attention_xla(q, k, v, causal=False, bias=full)
+        o = flash_attention(q, k, v, causal=False, bias=bias, bias_repeat=msa, interpret=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=3e-6)
+        g_ref = jax.grad(lambda b: attention_xla(q, k, v, causal=False,
+                                                 bias=jnp.repeat(b, msa, axis=0)).sum())(bias)
+        g = jax.grad(lambda b: flash_attention(q, k, v, causal=False, bias=b, bias_repeat=msa,
+                                               interpret=True).sum())(bias)
+        assert g.shape == bias.shape
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+    def test_causal_with_collapsed_bias(self):
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv(seed=2)
+        bias = jax.random.normal(jax.random.PRNGKey(11), (1, 2, 32, 32)) * 0.5
+        o_ref = attention_xla(q, k, v, causal=True, bias=jnp.broadcast_to(bias, (4, 2, 32, 32)))
+        o = flash_attention(q, k, v, causal=True, bias=bias, interpret=True)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=3e-6)
+        g_ref = jax.grad(lambda b: attention_xla(q, k, v, causal=True,
+                                                 bias=jnp.broadcast_to(b, (4, 2, 32, 32))).sum())(bias)
+        g = jax.grad(lambda b: flash_attention(q, k, v, causal=True, bias=b, interpret=True).sum())(bias)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-4)
+
+    def test_bad_bias_shape_rejected(self):
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+        q, k, v = self._qkv()
+        with pytest.raises(ValueError, match="broadcastable"):
+            flash_attention(q, k, v, causal=False, bias=jnp.zeros((3, 2, 32, 32)), interpret=True)
