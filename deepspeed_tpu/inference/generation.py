@@ -9,10 +9,25 @@ duplicates this loop per engine; keeping it single-sourced here means a
 sampling fix lands everywhere.
 """
 
+import weakref
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
+# per-model cache of jitted fused decode loops, keyed by the static
+# (length, sampling, eos) signature — rebuilding the jit per generate()
+# call would recompile every time
+_FUSED_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _decode_step(apply_fn, params, token, caches):
+    """THE per-token step (shared by the jitted loop and the fused scan)."""
+    B = token.shape[0]
+    cache_len = caches[0][2]
+    positions = jnp.full((B, 1), cache_len, jnp.int32)
+    logits, caches = apply_fn(params, token, positions=positions, kv_caches=caches)
+    return logits[:, -1, :], caches
 
 
 def build_step_fns(model) -> Tuple:
@@ -25,11 +40,7 @@ def build_step_fns(model) -> Tuple:
         return logits[:, -1, :], caches
 
     def decode_step(params, token, caches):
-        B = token.shape[0]
-        cache_len = caches[0][2]
-        positions = jnp.full((B, 1), cache_len, jnp.int32)
-        logits, caches = model.apply(params, token, positions=positions, kv_caches=caches)
-        return logits[:, -1, :], caches
+        return _decode_step(model.apply, params, token, caches)
 
     return jax.jit(prefill, donate_argnums=(2,)), jax.jit(decode_step, donate_argnums=(2,))
 
@@ -54,10 +65,56 @@ def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, 
     return jax.random.categorical(rng, logits, axis=-1)
 
 
+def _build_fused_decode(model, max_new_tokens: int, do_sample: bool, temperature: float, top_k: int,
+                        top_p: float, eos_token_id: Optional[int]):
+    """ONE jitted dispatch for the whole decode loop (lax.scan).
+
+    The python-loop path pays host->device dispatch per token AND per
+    sampling op — over a tunneled chip that is ~5+ roundtrips x ~1-3 ms
+    per generated token, which caps decode in the hundreds of tokens/s
+    regardless of the model. Scanning the step fuses prefill-to-final
+    into two dispatches total. EOS sequences keep emitting ``eos`` (no
+    host-side early exit — XLA control flow is length-static)."""
+
+    # weak ref: the cached jit's closure must not strongly reference the
+    # model, or the WeakKeyDictionary entry (key == model) never collects
+    model_ref = weakref.proxy(model)
+
+    def fused(params, logits, caches, rng):
+        B = logits.shape[0]
+        finished0 = jnp.zeros((B,), bool)
+
+        def step(carry, _):
+            logits, caches, rng, finished = carry
+            rng, step_rng = jax.random.split(rng)
+            token = sample_logits(logits, step_rng, do_sample, temperature, top_k, top_p)
+            if eos_token_id is not None:
+                token = jnp.where(finished, eos_token_id, token)
+                finished = finished | (token == eos_token_id)
+            logits, caches = _decode_step(model_ref.apply, params, token[:, None], caches)
+            return (logits, caches, rng, finished), token
+
+        (logits, caches, rng, finished), tokens = jax.lax.scan(
+            step, (logits, caches, rng, finished0), None, length=max_new_tokens - 1)
+        rng, last_rng = jax.random.split(rng)
+        last = sample_logits(logits, last_rng, do_sample, temperature, top_k, top_p)
+        if eos_token_id is not None:
+            last = jnp.where(finished, eos_token_id, last)
+        tokens = jnp.concatenate([tokens.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
+        return tokens
+
+    return jax.jit(fused, donate_argnums=(2,))
+
+
 def generate_tokens(model, params, prefill_fn, decode_fn, input_ids, *, max_new_tokens: int, cache_len: int,
                     cache_dtype, do_sample: bool = False, temperature: float = 1.0, top_k: int = 0,
-                    top_p: float = 1.0, eos_token_id: Optional[int] = None, seed: int = 0):
-    """Prefill + per-token decode loop; returns (B, S + new) token ids."""
+                    top_p: float = 1.0, eos_token_id: Optional[int] = None, seed: int = 0,
+                    fused: bool = True):
+    """Prefill + decode; returns (B, S + new) token ids.
+
+    ``fused=True`` (default) runs the whole decode loop as one compiled
+    ``lax.scan`` dispatch; ``fused=False`` keeps the per-token python loop
+    (supports host-side early exit when every sequence hit EOS)."""
     input_ids = jnp.asarray(input_ids, jnp.int32)
     if input_ids.ndim == 1:
         input_ids = input_ids[None]
@@ -65,6 +122,16 @@ def generate_tokens(model, params, prefill_fn, decode_fn, input_ids, *, max_new_
     caches = model.init_kv_caches(B, cache_len, dtype=cache_dtype)
     rng = jax.random.PRNGKey(seed)
     logits, caches = prefill_fn(params, input_ids, caches)
+
+    if fused and max_new_tokens > 0:
+        key = (max_new_tokens, do_sample, float(temperature), int(top_k), float(top_p), eos_token_id)
+        per_model = _FUSED_CACHE.setdefault(model, {})
+        fn = per_model.get(key)
+        if fn is None:
+            fn = per_model[key] = _build_fused_decode(model, max_new_tokens, do_sample, temperature,
+                                                      top_k, top_p, eos_token_id)
+        tokens = fn(params, logits, caches, rng)
+        return jnp.concatenate([input_ids, tokens], axis=1)
 
     out = [input_ids]
     finished = jnp.zeros((B,), bool)

@@ -269,6 +269,7 @@ def test_random_ltd_total_tokens_is_pure():
 
 
 # -------------------- engine integration --------------------
+@pytest.mark.nightly  # heavy engine-compiling e2e; unit coverage stays in the default tier
 def test_engine_curriculum_seqlen(tmp_path):
     import deepspeed_tpu
     from deepspeed_tpu.models import CausalLM, gpt2_tiny

@@ -1,15 +1,19 @@
-"""Heavy end-to-end tests demoted from the fast tier.
+"""Heavy end-to-end tests demoted from the fast tier (nightly tier).
 
-These five tests each compile one or more full engines (60-30s apiece on
+These five tests each compile one or more full engines (30-60s apiece on
 a 1-core box) and together consumed over half the fast tier's <2 min
-budget. They still run in the default suite; the fast tier keeps the
-quick unit-level coverage of the same modules.
+budget. They are marked ``nightly`` — excluded from the default run by
+pytest.ini's addopts; run them with ``-m nightly`` (or everything with
+``-m "nightly or not nightly"``). The fast/default tiers keep the quick
+unit-level coverage of the same modules.
 """
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.nightly
 
 from deepspeed_tpu.autotuning import Autotuner
 from test_autotuning import _tiny_setup  # tests/unit is on sys.path (conftest)

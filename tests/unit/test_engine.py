@@ -127,6 +127,7 @@ def test_checkpoint_save_load_resume(tmp_path):
     np.testing.assert_allclose(loss_after_3, loss_replay, rtol=1e-4)
 
 
+@pytest.mark.nightly  # heavy engine-compiling e2e; unit coverage stays in the default tier
 def test_checkpoint_across_stages(tmp_path):
     """Universal-checkpoint property: save under stage 2, load under stage 3."""
     engine = _make_engine(stage=2)

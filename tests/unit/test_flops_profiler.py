@@ -8,6 +8,7 @@ with exact-count checks made possible by the jaxpr-walking design.
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import deepspeed_tpu
 from deepspeed_tpu.profiling.flops_profiler import (FlopsProfiler, flops_of_fn, get_model_profile, flops_to_string,
@@ -78,6 +79,7 @@ def test_string_formatting():
     assert flops_to_string(2.0e12).startswith("2.00 T")
 
 
+@pytest.mark.nightly  # heavy engine-compiling e2e; unit coverage stays in the default tier
 def test_engine_profile_step(tmp_path):
     from deepspeed_tpu.models import CausalLM, gpt2_tiny
     from deepspeed_tpu.runtime.dataloader import RepeatingLoader

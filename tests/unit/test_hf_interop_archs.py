@@ -179,6 +179,7 @@ def test_new_arch_v2_ragged_serving(tmp_path, arch):
     np.testing.assert_allclose(logits2, ref2, rtol=3e-4, atol=3e-4)
 
 
+@pytest.mark.nightly  # heavy engine-compiling e2e; unit coverage stays in the default tier
 def test_parallel_block_trains(tmp_path):
     """New block types run the full engine train path (fused CE with head
     bias, parallel residual backward)."""

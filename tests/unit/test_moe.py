@@ -48,6 +48,7 @@ def test_dispatch_combine_roundtrip_identity_experts():
     np.testing.assert_allclose(np.asarray(out), np.asarray(x * top_p), atol=1e-5)
 
 
+@pytest.mark.nightly  # heavy engine-compiling e2e; unit coverage stays in the default tier
 def test_moe_model_trains():
     cfg = TransformerConfig(vocab_size=256, n_layers=2, n_heads=2, d_model=32, max_seq_len=32,
                             moe_num_experts=4, moe_top_k=2, moe_layer_freq=2)

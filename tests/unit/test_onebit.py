@@ -67,6 +67,7 @@ def test_compressed_allreduce_agrees_across_workers():
     assert agree > 0.7
 
 
+@pytest.mark.nightly  # ~7 min on a 1-core box: the long error-feedback convergence run
 def test_compressed_allreduce_error_feedback_converges():
     """Repeatedly reducing the SAME vectors with error feedback must drive
     the accumulated estimate toward the true mean (the 1-bit Adam claim)."""
