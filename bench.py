@@ -11,19 +11,26 @@ the per-chip micro-batch size and reports the best.
 rung only):
 - ``zero2`` (default) — ladder step 2.
 - ``zero3`` — same model under ZeRO-3 (stage-3 machinery on the fwd/bwd
-  path; same 350k/chip target: stage 3 on one chip must not regress).
+  path; same 114k/chip MFU-derived target: stage 3 on one chip must not regress).
 - ``decode`` — ladder step 5 analogue on one chip: greedy decode
   throughput (new tokens/s) of the v1 inference engine at batch 32.
   Target 25k tok/s/chip: decode is HBM-bound — 125M bf16 params =
   0.25 GB/step at v5e's ~820 GB/s gives ~3.2k steps/s upper bound x 32
   sequences x ~25% achievable.
 
-vs_baseline: ratio against a DeepSpeed reference point for the same model
-class: GPT-2-125M-scale training on one A100 runs at roughly 550k tokens/s
-at peak bf16 utilization ~50%; a v5e chip has ~197 bf16 TFLOPs vs A100's
-312, so the reference-equivalent per-chip target is ~350k tokens/s. We
-report value/350k. (No in-tree reference numbers exist: BASELINE.json
-.published = {}.)
+vs_baseline: ratio against a DeepSpeed-equivalent reference point derived
+from first principles (round-2 verdict asked for the arithmetic to be
+cross-checked — the previous 350k/chip figure implied >130% MFU on the
+A100 it was scaled from, i.e. it was impossible):
+  GPT-2-124M fwd+bwd ~= 6*N + 12*L*d*S FLOPs/token
+                      = 6*124e6 + 12*12*768*1024 ~= 0.86 GF/token.
+  A100 (312 bf16 TF/s) at a DeepSpeed-class 50% MFU -> 156e12/0.86e9
+                      ~= 181k tokens/s/GPU.
+  v5e (197 bf16 TF/s) equivalent: 181k * 197/312 ~= 114k tokens/s/chip.
+We report value/114k, so vs_baseline = 1.0 means matching a well-tuned
+A100 DeepSpeed run chip-for-chip at equal MFU, and vs_baseline ~= 2.0 is
+the hardware ceiling (100% MFU). (No in-tree reference numbers exist:
+BASELINE.json.published = {}.)
 
 Timing protocol: the engine keeps the whole step on-device (no per-step
 host syncs under bf16), so we dispatch `iters` chained steps and force
@@ -217,7 +224,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
     if best[1] is None:
         raise RuntimeError("every sweep config failed")
     tokens_per_sec_chip = best[0] / n_dev
-    baseline_tokens_per_sec_chip = 350_000.0  # see module docstring
+    baseline_tokens_per_sec_chip = 114_000.0  # see module docstring (MFU-derived)
     return {
         "metric": f"gpt2-125m_zero{stage}_bf16_train_tokens_per_sec_per_chip{tag}" if platform == "tpu"
         else f"tiny_zero{stage}_bf16_train_tokens_per_sec_per_chip{tag}",
