@@ -223,6 +223,35 @@ def all_gather_object(obj, group=None):
     return [obj]
 
 
+def broadcast_object_list(object_list, src: int = 0, group=None, device=None):
+    """In-place broadcast of picklable objects from global rank ``src``
+    (reference ``torch.distributed.broadcast_object_list``).
+
+    True one-to-all: only the source process pickles (non-src placeholder
+    contents may be arbitrary, as in torch) and wire traffic is O(payload),
+    not O(world * payload). ``src`` is a global rank; it maps to the
+    process that owns it."""
+    world = get_world_size()
+    if not 0 <= src < world:
+        raise ValueError(f"broadcast_object_list: src {src} out of range for world size {world}")
+    if jax.process_count() > 1:
+        import pickle
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        src_proc = src // max(1, world // jax.process_count())
+        is_src = jax.process_index() == src_proc
+        blob = (np.frombuffer(pickle.dumps(list(object_list)), np.uint8) if is_src
+                else np.zeros((0,), np.uint8))
+        n = multihost_utils.broadcast_one_to_all(np.asarray([blob.size], np.int64), is_source=is_src)
+        buf = np.zeros((int(n[0]),), np.uint8)
+        buf[:blob.size] = blob
+        data = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=is_src))
+        object_list[:] = pickle.loads(data.tobytes())
+    return object_list
+
+
 def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
     return barrier(group)
 
