@@ -1,7 +1,14 @@
+from .estimator import (estimate_zero2_model_states_mem_needs, estimate_zero2_model_states_mem_needs_all_cold,
+                        estimate_zero2_model_states_mem_needs_all_live, estimate_zero3_model_states_mem_needs,
+                        estimate_zero3_model_states_mem_needs_all_cold,
+                        estimate_zero3_model_states_mem_needs_all_live)
 from .init import Init
 from .mics import MiCS_Init, validate_mics_mesh
 from .partition import (batch_specs, plan_grad_specs, plan_opt_state_specs, plan_param_specs, shard_leaf_spec,
                         specs_to_shardings, zero_axes_for)
 
 __all__ = ["plan_param_specs", "plan_grad_specs", "plan_opt_state_specs", "shard_leaf_spec", "specs_to_shardings",
-           "batch_specs", "zero_axes_for", "Init", "MiCS_Init", "validate_mics_mesh"]
+           "batch_specs", "zero_axes_for", "Init", "MiCS_Init", "validate_mics_mesh",
+           "estimate_zero2_model_states_mem_needs", "estimate_zero2_model_states_mem_needs_all_live",
+           "estimate_zero2_model_states_mem_needs_all_cold", "estimate_zero3_model_states_mem_needs",
+           "estimate_zero3_model_states_mem_needs_all_live", "estimate_zero3_model_states_mem_needs_all_cold"]

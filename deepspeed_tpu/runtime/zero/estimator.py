@@ -1,0 +1,178 @@
+"""Closed-form ZeRO memory-needs estimators.
+
+Capability parity: reference ``runtime/zero/stage_1_and_2.py:2423`` and
+``stage3.py:2674`` (``estimate_zero{2,3}_model_states_mem_needs`` plus the
+``_all_live`` / ``_all_cold`` table printers) — the public what-if
+calculators users run before renting a cluster. The bytes-per-param
+arithmetic is copied from the reference's formulas verbatim (they are
+arithmetic facts: mixed-precision params 2, grads 2, fp32 master + Adam
+moments 12, stage-2 grad buckets, offload scenarios); the live variants
+take a parameter *pytree* instead of an ``nn.Module``.
+
+For the *compiled* truth (activations, collective staging, scheduler
+behaviour) use :func:`deepspeed_tpu.runtime.memory_audit.audit_train_step`
+— these estimators cover model states only, like the reference.
+"""
+
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from ...utils.comms_logging import convert_size
+
+
+def params_of_tree(params: Any) -> Tuple[int, int]:
+    """(total_params, largest_layer_params) of a parameter pytree.
+
+    The 'largest layer' follows the reference's ``model_to_params``
+    (``stage3.py:2714``: per-module ``recurse=False`` max): every internal
+    pytree node contributes the sum of its IMMEDIATE array leaves.
+
+    Caveat: a ``scan_layers`` tree stacks all blocks into single (L, ...)
+    arrays, which inflates per-group sizes by the stack factor — pass an
+    explicit ``largest_layer_params`` to the printers for those trees.
+    """
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves or not all(hasattr(l, "shape") for l in leaves):
+        raise ValueError("params_of_tree expects a parameter pytree of arrays "
+                         "(e.g. the tree returned by model.init), got "
+                         f"{type(params).__name__}")
+    total = sum(int(np.prod(l.shape)) if l.shape else 1 for l in leaves)
+
+    largest = 0
+
+    def visit(node):
+        nonlocal largest
+        if isinstance(node, dict):
+            children = node.values()
+        elif isinstance(node, (list, tuple)):
+            children = node
+        else:
+            return
+        direct = sum(int(np.prod(c.shape)) if c.shape else 1
+                     for c in children if hasattr(c, "shape"))
+        largest = max(largest, direct)
+        for c in children:
+            visit(c)
+
+    visit(params)
+    if largest == 0:  # a bare leaf / flat tree: the whole thing is one group
+        largest = total
+    return total, largest
+
+
+def estimate_zero2_model_states_mem_needs(total_params: int, num_chips_per_host: int = 1,
+                                          num_hosts: int = 1, cpu_offload: bool = True,
+                                          additional_buffer_factor: float = 1.5) -> Tuple[int, int]:
+    """(host_mem, chip_mem) bytes for ZeRO-1/2 model states.
+
+    Reference ``stage_1_and_2.py:2423`` — identical arithmetic."""
+    total_chips = num_hosts * num_chips_per_host
+    if cpu_offload:
+        chip_mem = 2 * total_params
+        host_mem = total_params * max(4 * total_chips, 16) * additional_buffer_factor
+    else:
+        chip_mem = 4 * total_params + int(16 * total_params / total_chips)
+        host_mem = total_params * 4 * num_chips_per_host * additional_buffer_factor
+    return int(host_mem), int(chip_mem)
+
+
+def estimate_zero3_model_states_mem_needs(total_params: int, largest_layer_params: int,
+                                          num_chips_per_host: int = 1, num_hosts: int = 1,
+                                          cpu_offload: bool = True, cpu_offload_params: bool = True,
+                                          zero_init: bool = True,
+                                          additional_buffer_factor: float = 1.5) -> Tuple[int, int, int]:
+    """(host_mem, chip_mem, largest_layer_mem) bytes for ZeRO-3 model states.
+
+    Reference ``stage3.py:2674`` — identical arithmetic."""
+    total_chips = num_hosts * num_chips_per_host
+    host_factor = 1 / num_hosts
+    largest_layer_memory = 4 * largest_layer_params
+
+    if cpu_offload:
+        if cpu_offload_params:
+            chip_mem = largest_layer_memory
+            if zero_init:
+                host_mem = total_params * 18 * host_factor * additional_buffer_factor
+            else:
+                host_mem = total_params * max(4 * num_chips_per_host, 18 * host_factor) \
+                    * additional_buffer_factor
+        else:
+            chip_mem = largest_layer_memory + int(2 * total_params / total_chips)
+            if zero_init:
+                host_mem = total_params * 16 * host_factor * additional_buffer_factor
+            else:
+                host_mem = total_params * max(4 * num_chips_per_host, 16 * host_factor) \
+                    * additional_buffer_factor
+    else:
+        chip_mem = largest_layer_memory + int(18 * total_params / total_chips)
+        if zero_init:
+            host_mem = largest_layer_params * 4 * num_chips_per_host * additional_buffer_factor
+        else:
+            host_mem = total_params * 4 * num_chips_per_host * additional_buffer_factor
+    return int(host_mem), int(chip_mem), largest_layer_memory
+
+
+def _hw_header(total: int, num_chips_per_host: int, num_hosts: int, largest: Optional[int] = None) -> None:
+    sw = f"SW: Model with {int(total / 1e6)}M total params"
+    if largest is not None:
+        sw += f", {int(largest / 1e6)}M largest layer params"
+    print("Estimated memory needed for params, optim states and gradients for a:\n"
+          f"HW: Setup with {num_hosts} host{'s' if num_hosts > 1 else ''}, "
+          f"{num_chips_per_host} chip{'s' if num_chips_per_host > 1 else ''} per host.\n" + sw + ".")
+    print("  per CPU  |  per Chip |   Options")
+
+
+def estimate_zero2_model_states_mem_needs_all_cold(total_params: int, num_chips_per_host: int = 1,
+                                                   num_hosts: int = 1,
+                                                   additional_buffer_factor: float = 1.5) -> None:
+    """Print the ZeRO-1/2 scenario table for a hypothetical model
+    (reference ``stage_1_and_2.py:2477``)."""
+    _hw_header(total_params, num_chips_per_host, num_hosts)
+    for offload in (True, False):
+        host, chip = estimate_zero2_model_states_mem_needs(
+            total_params, num_chips_per_host, num_hosts, cpu_offload=offload,
+            additional_buffer_factor=additional_buffer_factor)
+        print(f"  {convert_size(host):>8} | {convert_size(chip):>8} | "
+              f"offload_optimizer={'cpu' if offload else 'none'}")
+
+
+def estimate_zero2_model_states_mem_needs_all_live(params, num_chips_per_host: int = 1,
+                                                   num_hosts: int = 1,
+                                                   additional_buffer_factor: float = 1.5) -> None:
+    """Print the ZeRO-1/2 scenario table for a live parameter pytree."""
+    total, _ = params_of_tree(params)
+    estimate_zero2_model_states_mem_needs_all_cold(total, num_chips_per_host, num_hosts,
+                                                   additional_buffer_factor)
+
+
+def estimate_zero3_model_states_mem_needs_all_cold(total_params: int, largest_layer_params: int,
+                                                   num_chips_per_host: int = 1, num_hosts: int = 1,
+                                                   additional_buffer_factor: float = 1.5) -> None:
+    """Print the ZeRO-3 scenario table for a hypothetical model
+    (reference ``stage3.py:2757``)."""
+    _hw_header(total_params, num_chips_per_host, num_hosts, largest_layer_params)
+    for offload, offload_p, zinit in ((True, True, True), (True, True, False), (True, False, True),
+                                      (True, False, False), (False, False, True), (False, False, False)):
+        host, chip, _ = estimate_zero3_model_states_mem_needs(
+            total_params, largest_layer_params, num_chips_per_host, num_hosts, cpu_offload=offload,
+            cpu_offload_params=offload_p, zero_init=zinit,
+            additional_buffer_factor=additional_buffer_factor)
+        opts = (f"offload_param={'cpu' if offload_p else 'none'}, "
+                f"offload_optimizer={'cpu' if offload else 'none'}, zero_init={int(zinit)}")
+        print(f"  {convert_size(host):>8} | {convert_size(chip):>8} | {opts}")
+
+
+def estimate_zero3_model_states_mem_needs_all_live(params, num_chips_per_host: int = 1,
+                                                   num_hosts: int = 1,
+                                                   additional_buffer_factor: float = 1.5,
+                                                   largest_layer_params: Optional[int] = None) -> None:
+    """Print the ZeRO-3 scenario table for a live parameter pytree
+    (reference ``stage3.py:2726``). ``largest_layer_params`` overrides the
+    derived per-group max (needed for ``scan_layers`` stacked trees)."""
+    total, largest = params_of_tree(params)
+    estimate_zero3_model_states_mem_needs_all_cold(total, largest_layer_params or largest,
+                                                   num_chips_per_host, num_hosts,
+                                                   additional_buffer_factor)

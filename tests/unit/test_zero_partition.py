@@ -110,3 +110,60 @@ def test_opt_state_specs_stage0_replicated():
 
 # quick tier: `pytest -m fast` smoke run
 pytestmark = pytest.mark.fast
+
+
+class TestMemEstimators:
+    """Reference stage_1_and_2.py:2423 / stage3.py:2674 estimator parity."""
+
+    def test_zero3_formula_matches_reference_arithmetic(self):
+        from deepspeed_tpu.runtime.zero import estimate_zero3_model_states_mem_needs
+
+        total, largest = 7_000_000_000, 400_000_000
+        # full offload: chip holds only the largest gathered layer
+        host, chip, big = estimate_zero3_model_states_mem_needs(
+            total, largest, num_chips_per_host=8, num_hosts=4)
+        assert chip == big == 4 * largest
+        assert host == int(total * 18 * (1 / 4) * 1.5)
+        # no offload: 18 bytes/param sharded over all chips + gathered layer
+        host, chip, _ = estimate_zero3_model_states_mem_needs(
+            total, largest, num_chips_per_host=8, num_hosts=4,
+            cpu_offload=False, cpu_offload_params=False)
+        assert chip == 4 * largest + int(18 * total / 32)
+
+    def test_zero2_formula_matches_reference_arithmetic(self):
+        from deepspeed_tpu.runtime.zero import estimate_zero2_model_states_mem_needs
+
+        # reference stage_1_and_2.py:2423: 4 bytes/param on chip + 16/dp sharded
+        host, chip = estimate_zero2_model_states_mem_needs(1_000_000, num_chips_per_host=4,
+                                                           cpu_offload=False)
+        assert chip == 4 * 1_000_000 + int(16 * 1_000_000 / 4)
+        assert host == int(1_000_000 * 4 * 4 * 1.5)
+        # offload: chip holds bf16 params only
+        host, chip = estimate_zero2_model_states_mem_needs(1_000_000, num_chips_per_host=4)
+        assert chip == 2 * 1_000_000
+        assert host == int(1_000_000 * max(4 * 4, 16) * 1.5)
+
+    def test_scan_layers_override_and_pytree_validation(self):
+        import pytest as _pytest
+
+        from deepspeed_tpu.runtime.zero import estimate_zero3_model_states_mem_needs_all_live
+        from deepspeed_tpu.runtime.zero.estimator import params_of_tree
+
+        with _pytest.raises(ValueError, match="parameter pytree"):
+            params_of_tree(object())
+
+    def test_all_live_prints_scenarios(self, capsys):
+        import jax
+        import numpy as np
+
+        from deepspeed_tpu.models import CausalLM, gpt2_tiny
+        from deepspeed_tpu.runtime.zero import (estimate_zero2_model_states_mem_needs_all_live,
+                                                estimate_zero3_model_states_mem_needs_all_live)
+
+        model = CausalLM(gpt2_tiny())
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+        estimate_zero3_model_states_mem_needs_all_live(params, num_chips_per_host=8)
+        estimate_zero2_model_states_mem_needs_all_live(params, num_chips_per_host=8)
+        out = capsys.readouterr().out
+        assert "per Chip" in out and "offload_param=cpu" in out and "offload_optimizer=cpu" in out
+        assert out.count("|") >= 16  # both tables rendered
