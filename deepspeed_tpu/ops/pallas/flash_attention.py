@@ -105,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, bias_ref, o_ref, lse_ref, *, bq
     qi = pl.program_id(1)
     q = q_ref[0]  # (bq, D) input dtype — MXU runs bf16 operands w/ fp32 accumulation
     D = q.shape[-1]
-    slope = slopes_ref[0, 0]  # per-head ALiBi slope (0 when disabled)
+    slope = slopes_ref[0, 0, 0]  # per-head ALiBi slope (0 when disabled)
 
     # queries align to the END of the kv sequence (matches attention_xla)
     offset = seq_k - seq_q
@@ -173,7 +173,7 @@ def _flash_fwd(q, k, v, slopes, bias, scale: float, causal: bool, interpret: boo
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
+            pl.BlockSpec((1, 1, LANES), lambda b, i: (b, 0, 0)),
             bias_spec,
         ],
         out_specs=[
@@ -195,7 +195,7 @@ def _flash_fwd(q, k, v, slopes, bias, scale: float, causal: bool, interpret: boo
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias_ref, dq_ref, dbias_ref, *,
                bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, has_bias):
     qi = pl.program_id(1)
-    slope = slopes_ref[0, 0]
+    slope = slopes_ref[0, 0, 0]
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
@@ -244,7 +244,7 @@ def _dq_kernel_collapsed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes
     """
     qi = pl.program_id(1)
     rep = pl.program_id(2)
-    slope = slopes_ref[0, 0]
+    slope = slopes_ref[0, 0, 0]
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
@@ -287,7 +287,7 @@ def _dq_kernel_collapsed(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, bias_ref, dk_ref, dv_ref, *,
                 bq, bk, seq_q, seq_k, scale, causal, has_alibi, window, has_bias, sqb1: bool = False):
     kj = pl.program_id(1)
-    slope = slopes_ref[0, 0]
+    slope = slopes_ref[0, 0, 0]
     k = k_ref[0]
     v = v_ref[0]
     D = k.shape[-1]
@@ -374,7 +374,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
                 pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
                 pl.BlockSpec((1, bq, LANES), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, LANES), lambda b, i: (b, 0)),
+                pl.BlockSpec((1, 1, LANES), lambda b, i: (b, 0, 0)),
                 bias_spec_q2,
             ],
             out_specs=[
@@ -412,7 +412,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
                 pl.BlockSpec((1, bq, D), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
                 pl.BlockSpec((1, bq, LANES), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
                 pl.BlockSpec((1, bq, LANES), lambda bh, i, rep: (q_b(bh, rep), i, 0)),
-                pl.BlockSpec((1, LANES), lambda bh, i, rep: (q_b(bh, rep), 0)),
+                pl.BlockSpec((1, 1, LANES), lambda bh, i, rep: (q_b(bh, rep), 0, 0)),
                 bias_spec_q3,
             ],
             out_specs=[
@@ -438,7 +438,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
             pl.BlockSpec((1, Sq, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, Sq, LANES), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, LANES), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, 1, LANES), lambda b, j: (b, 0, 0)),
             bias_spec_k,
         ],
         out_specs=[
@@ -464,9 +464,14 @@ def _flash(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, b
 
 
 def _bh_slopes(slopes, B, H):
-    """(H,) per-head slopes -> (B*H, LANES) per-program rows."""
+    """(H,) per-head slopes -> (B*H, 1, LANES) per-program rows.
+
+    3D on purpose: real TPU lowering requires the last two block dims to be
+    divisible by (8, 128) or equal the array dims — a (1, LANES) block over
+    a 2D (B*H, LANES) array is rejected (only interpret mode accepts it).
+    With a leading program dim the (1, LANES) tail matches exactly."""
     flat = jnp.tile(jnp.asarray(slopes, jnp.float32), B)  # (B*H,)
-    return jnp.broadcast_to(flat[:, None], (B * H, LANES))
+    return jnp.broadcast_to(flat[:, None, None], (B * H, 1, LANES))
 
 
 def _flash_core(q, k, v, slopes, bias, scale, causal, interpret, has_alibi, window, bias_meta, H):

@@ -19,6 +19,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+try:  # TPU-only submodule; absent on CPU-only jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
 from ..registry import pallas_available
 from .sparsity_config import SparsityConfig
 
@@ -57,14 +62,15 @@ def _active_lists(layout: np.ndarray, causal: bool):
 # kernels
 # ----------------------------------------------------------------------
 def _sp_fwd_kernel(q_ref, k_ref, v_ref, kidx_ref, o_ref, lse_ref, *, blk: int, n_active: int, scale: float,
-                   causal: bool):
+                   causal: bool, H: int):
     qi = pl.program_id(1)
+    h = pl.program_id(0) % H
     q = q_ref[0]  # (blk, D)
     D = q.shape[-1]
 
     def body(t, carry):
         acc, m, l = carry
-        j = kidx_ref[0, 0, t]
+        j = kidx_ref[h, qi, t]
         valid = j >= 0
         jc = jnp.maximum(j, 0)
         k = k_ref[0, pl.dslice(jc * blk, blk), :]
@@ -96,8 +102,9 @@ def _sp_fwd_kernel(q_ref, k_ref, v_ref, kidx_ref, o_ref, lse_ref, *, blk: int, n
 
 
 def _sp_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kidx_ref, dq_ref, *, blk, n_active, scale,
-                  causal):
+                  causal, H):
     qi = pl.program_id(1)
+    h = pl.program_id(0) % H
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0, :, 0]
@@ -105,7 +112,7 @@ def _sp_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kidx_ref, dq_
     D = q.shape[-1]
 
     def body(t, dq):
-        j = kidx_ref[0, 0, t]
+        j = kidx_ref[h, qi, t]
         valid = j >= 0
         jc = jnp.maximum(j, 0)
         k = k_ref[0, pl.dslice(jc * blk, blk), :]
@@ -127,15 +134,16 @@ def _sp_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, kidx_ref, dq_
 
 
 def _sp_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qidx_ref, dk_ref, dv_ref, *, blk, n_active,
-                   scale, causal):
+                   scale, causal, H):
     kj = pl.program_id(1)
+    h = pl.program_id(0) % H
     k = k_ref[0]
     v = v_ref[0]
     D = k.shape[-1]
 
     def body(t, carry):
         dk, dv = carry
-        i = qidx_ref[0, 0, t]
+        i = qidx_ref[h, kj, t]
         valid = i >= 0
         ic = jnp.maximum(i, 0)
         q = q_ref[0, pl.dslice(ic * blk, blk), :]
@@ -167,10 +175,21 @@ def _sp_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qidx_ref, dk
 # ----------------------------------------------------------------------
 # pallas_call plumbing ((B*H, S, D) layout like flash_attention)
 # ----------------------------------------------------------------------
+def _idx_spec(shape):
+    # the whole active-list table rides in SMEM un-blocked (kernels read one
+    # scalar per fori_loop step, indexed by program ids). Real TPU lowering
+    # applies the (8, 128) tiling rule to every spec WITH a block shape —
+    # even in SMEM — so a (1, 1, A) block is rejected; only full-array
+    # scalar-memory specs are exempt.
+    if pltpu is not None:
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(shape, lambda *_: (0,) * len(shape))  # interpret-only fallback
+
+
 def _sp_fwd(q, k, v, kidx, H, blk, scale, causal, interpret):
     BH, S, D = q.shape
     nq, A = kidx.shape[1], kidx.shape[2]
-    kernel = functools.partial(_sp_fwd_kernel, blk=blk, n_active=A, scale=scale, causal=causal)
+    kernel = functools.partial(_sp_fwd_kernel, blk=blk, n_active=A, scale=scale, causal=causal, H=H)
     o, lse = pl.pallas_call(
         kernel,
         grid=(BH, nq),
@@ -178,7 +197,7 @@ def _sp_fwd(q, k, v, kidx, H, blk, scale, causal, interpret):
             pl.BlockSpec((1, blk, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, 1, A), lambda b, i: (b % H, i, 0)),
+            _idx_spec(kidx.shape),
         ],
         out_specs=[
             pl.BlockSpec((1, blk, D), lambda b, i: (b, i, 0)),
@@ -201,7 +220,7 @@ def _sp_bwd(q, k, v, o, lse, do, kidx, qidx, H, blk, scale, causal, interpret):
     delta = jnp.broadcast_to(delta[..., None], (BH, S, LANES))
 
     dq = pl.pallas_call(
-        functools.partial(_sp_dq_kernel, blk=blk, n_active=A, scale=scale, causal=causal),
+        functools.partial(_sp_dq_kernel, blk=blk, n_active=A, scale=scale, causal=causal, H=H),
         grid=(BH, nq),
         in_specs=[
             pl.BlockSpec((1, blk, D), lambda b, i: (b, i, 0)),
@@ -210,7 +229,7 @@ def _sp_bwd(q, k, v, o, lse, do, kidx, qidx, H, blk, scale, causal, interpret):
             pl.BlockSpec((1, blk, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, blk, LANES), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, blk, LANES), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, A), lambda b, i: (b % H, i, 0)),
+            _idx_spec(kidx.shape),
         ],
         out_specs=pl.BlockSpec((1, blk, D), lambda b, i: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
@@ -218,7 +237,7 @@ def _sp_bwd(q, k, v, o, lse, do, kidx, qidx, H, blk, scale, causal, interpret):
     )(q, k, v, do, lse, delta, kidx)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_sp_dkv_kernel, blk=blk, n_active=Aq, scale=scale, causal=causal),
+        functools.partial(_sp_dkv_kernel, blk=blk, n_active=Aq, scale=scale, causal=causal, H=H),
         grid=(BH, nk),
         in_specs=[
             pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
@@ -227,7 +246,7 @@ def _sp_bwd(q, k, v, o, lse, do, kidx, qidx, H, blk, scale, causal, interpret):
             pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, S, LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((1, S, LANES), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, 1, Aq), lambda b, j: (b % H, j, 0)),
+            _idx_spec(qidx.shape),
         ],
         out_specs=[
             pl.BlockSpec((1, blk, D), lambda b, j: (b, j, 0)),
