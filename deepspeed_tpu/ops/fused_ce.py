@@ -27,11 +27,24 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-_CHUNK_TARGET = int(os.environ.get("DS_TPU_CE_CHUNK", 512))
+_CHUNK_TARGET = int(os.environ.get("DS_TPU_CE_CHUNK", 0))  # 0 = auto (memory-budgeted)
+_BUDGET_MB = int(os.environ.get("DS_TPU_CE_BUDGET_MB", 4096))
 
 
-def _pick_chunk(S: int, target: Optional[int] = None) -> int:
-    target = target or _CHUNK_TARGET
+def _auto_target(S: int, B: int, V: int) -> int:
+    """Largest chunk whose fp32 logits block fits the budget.
+
+    Hardware A/B (round 3, v5e, GPT-2-125M bs=16): chunk=S beat chunk=512
+    by 2.2% (119.3k vs 116.8k tok/s) — the lax.scan carry costs more than
+    the larger logits block saves, so prefer the biggest chunk memory
+    allows and only chunk when the block would blow the budget.
+    """
+    rows = max(1, (_BUDGET_MB << 20) // max(1, B * V * 4))
+    return S if rows >= S else max(64, rows)
+
+
+def _pick_chunk(S: int, target: Optional[int] = None, B: int = 8, V: int = 50257) -> int:
+    target = target or _CHUNK_TARGET or _auto_target(S, B, V)
     if target <= 0:
         target = 512
     # fall back only DOWNWARD: a chunk above the requested target would
@@ -165,7 +178,7 @@ def fused_cross_entropy(x: jnp.ndarray,
     """
     B, S, D = x.shape
     V = w.shape[0] if vd_layout else w.shape[1]
-    chunk = chunk or _pick_chunk(S)
+    chunk = chunk or _pick_chunk(S, B=B, V=V)
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0).astype(jnp.int32)
     has_bias = bias is not None

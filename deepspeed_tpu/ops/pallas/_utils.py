@@ -7,3 +7,19 @@ def block_that_divides(n: int, want: int) -> int:
     while n % b:
         b //= 2
     return max(b, 1)
+
+
+try:  # TPU-only submodule; absent on CPU-only jaxlib builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def compiler_params(*semantics, interpret):
+    """Mosaic dimension semantics: 'parallel' grid dims let the pipeline
+    overlap the next program's DMA with current compute — valid whenever
+    the dim carries no cross-program state."""
+    if interpret or pltpu is None:
+        return None
+    cls = getattr(pltpu, "CompilerParams", None) or getattr(pltpu, "TPUCompilerParams", None)
+    return cls(dimension_semantics=semantics) if cls is not None else None

@@ -23,7 +23,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 from ..registry import REGISTRY, pallas_available
-from ._utils import block_that_divides
+from ._utils import block_that_divides, compiler_params as _compiler_params
 
 NEG_INF = -1e30
 LANES = 128  # min lane width for fp32 stores (canonical TPU l/m layout)
@@ -185,6 +185,7 @@ def _flash_fwd(q, k, v, slopes, bias, scale: float, causal: bool, interpret: boo
             jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
     )(q, k, v, slopes, bias)
     return o, lse
 
@@ -386,6 +387,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
                 jax.ShapeDtypeStruct(dbias_shape, jnp.float32),
             ],
             interpret=interpret,
+            compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
         )(q, k, v, do, lse, delta, slopes, bias)
     else:
         # broadcast bias: repeat dim innermost so every program sharing a
@@ -424,6 +426,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
                 jax.ShapeDtypeStruct(dbias_shape, jnp.float32),
             ],
             interpret=interpret,
+            compiler_params=_compiler_params("parallel", "arbitrary", "arbitrary", interpret=interpret),
         )(q, k, v, do, lse, delta, slopes, bias)
 
     dk, dv = pl.pallas_call(
@@ -450,6 +453,7 @@ def _flash_bwd(q, k, v, o, lse, do, slopes, bias, scale: float, causal: bool, in
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
     )(q, k, v, do, lse, delta, slopes, bias)
     return dq, dk, dv, dbias
 

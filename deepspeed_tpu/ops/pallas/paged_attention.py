@@ -32,6 +32,8 @@ try:  # TPU-only submodule; absent on CPU-only jaxlib builds
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ._utils import compiler_params as _compiler_params
+
 NEG_INF = -1e30
 
 
@@ -117,32 +119,38 @@ def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, slopes_r
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].reshape(kvh, g, d).astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)  # (bs, kvh, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.einsum("kgd,tkd->kgt", q, k, preferred_element_type=jnp.float32)
-        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, bs), 2)
-        if has_alibi:
-            sl = slopes_ref[:, 0].reshape(kvh, g)[..., None]
-            s = s + sl * pos.astype(jnp.float32)
+        # NOTE: the head dim is a STATIC python loop of 2D matmuls — Mosaic's
+        # compiler crashes on batched 3D dots ("kgd,tkd->kgt"), bisected on
+        # hardware in round 3. Decode is HBM-bound; skinny dots are fine.
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (g, bs), 1)
         valid = pos < ctx
         if window > 0:
             valid = valid & (pos > ctx - 1 - window)
-        s = jnp.where(valid, s, NEG_INF)
-
-        m_prev = m_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        pij = jnp.exp(s - m_new[..., None])
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(pij, axis=-1)
-        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum("kgt,tkd->kgd", pij, v)
-        m_ref[...] = m_new
+        for h in range(kvh):
+            qh = q_ref[0, pl.dslice(h * g, g), :].astype(jnp.float32) * scale  # (g, d)
+            kh = k_ref[0, :, h, :].astype(jnp.float32)  # (bs, d)
+            vh = v_ref[0, :, h, :].astype(jnp.float32)
+            s = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)  # (g, bs)
+            if has_alibi:
+                sl = slopes_ref[pl.dslice(h * g, g), 0]  # (g,)
+                s = s + sl[:, None] * pos.astype(jnp.float32)
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[h]  # (g,)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            pij = jnp.exp(s - m_new[:, None])
+            l_ref[h] = l_ref[h] * alpha + jnp.sum(pij, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + jax.lax.dot_general(
+                pij, vh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            m_ref[h] = m_new
 
     @pl.when(p == pages - 1)
     def _finish():
-        l = l_ref[...]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[...] / l[..., None]).reshape(kvh * g, d).astype(o_ref.dtype)
+        for h in range(kvh):
+            l = l_ref[h]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, pl.dslice(h * g, g), :] = (acc_ref[h] / l[:, None]).astype(o_ref.dtype)
 
 
 def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
@@ -194,8 +202,7 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("parallel", "arbitrary")) if not interpret and
-        hasattr(pltpu, "TPUCompilerParams") else None,
+        compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
     )(block_tables, ctx_lens, q, k_pages, v_pages, slopes_in)
 
 
@@ -209,9 +216,12 @@ def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_r
     chunk of S_q query tokens with online softmax — the prefill sibling of
     ``_decode_kernel`` (reference blocked_flash over the paged pool).
     ``qpos0`` is each sequence's absolute position of query row 0 (chunked
-    prefill continues a partially-written context)."""
+    prefill continues a partially-written context). Per-kv-head rows are
+    flattened to 2D (s_q*g, ...) — see the Mosaic 3D-dot note in
+    ``_decode_kernel``."""
     b = pl.program_id(0)
     p = pl.program_id(1)
+    sg = s_q * g
 
     @pl.when(p == 0)
     def _init():
@@ -229,35 +239,45 @@ def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_r
 
     @pl.when(live)
     def _compute():
-        q = q_ref[0].reshape(s_q, kvh, g, d).astype(jnp.float32) * scale
-        k = k_ref[0].astype(jnp.float32)  # (bs, kvh, d)
-        v = v_ref[0].astype(jnp.float32)
-        s = jnp.einsum("skgd,tkd->kgst", q, k, preferred_element_type=jnp.float32)
-        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, 1, 1, bs), 3)
-        if has_alibi:
-            sl = slopes_ref[:, 0].reshape(kvh, g)[:, :, None, None]
-            s = s + sl * pos.astype(jnp.float32)
-        qpos = q0 + jax.lax.broadcasted_iota(jnp.int32, (1, 1, s_q, 1), 2)
+        # flattened row r = s_idx * g + g_idx (row-major (s_q, g) collapse)
+        rows_s = jax.lax.broadcasted_iota(jnp.int32, (sg, bs), 0) // g
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (sg, bs), 1)
+        qpos = q0 + rows_s
         valid = (pos < ctx) & (pos <= qpos)  # causal against absolute positions
         if window > 0:
             valid = valid & (pos > qpos - window)
-        s = jnp.where(valid, s, NEG_INF)
-
-        m_prev = m_ref[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
-        alpha = jnp.exp(m_prev - m_new)
-        pij = jnp.exp(s - m_new[..., None])
-        pij = jnp.where(s <= NEG_INF, 0.0, pij)  # rows with no visible key yet
-        l_ref[...] = l_ref[...] * alpha + jnp.sum(pij, axis=-1)
-        acc_ref[...] = acc_ref[...] * alpha[..., None] + jnp.einsum("kgst,tkd->kgsd", pij, v)
-        m_ref[...] = m_new
+        for h in range(kvh):
+            qh = q_ref[0, :, pl.dslice(h * g, g), :].reshape(sg, d).astype(jnp.float32) * scale
+            kh = k_ref[0, :, h, :].astype(jnp.float32)  # (bs, d)
+            vh = v_ref[0, :, h, :].astype(jnp.float32)
+            s = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)  # (sg, bs)
+            if has_alibi:
+                if g == 1:
+                    # scalar slope: a (1,) vector source becomes an illegal
+                    # both-dims broadcast in Mosaic ("sublanes and lanes")
+                    s = s + slopes_ref[h, 0] * pos.astype(jnp.float32)
+                else:
+                    sl = slopes_ref[pl.dslice(h * g, g), 0]  # (g,) -> per-row g_idx = r % g
+                    sl_rows = jnp.broadcast_to(sl[None, :], (s_q, g)).reshape(sg, 1)
+                    s = s + sl_rows * pos.astype(jnp.float32)
+            s = jnp.where(valid, s, NEG_INF)
+            m_prev = m_ref[h]  # (sg,)
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+            alpha = jnp.exp(m_prev - m_new)
+            pij = jnp.exp(s - m_new[:, None])
+            pij = jnp.where(s <= NEG_INF, 0.0, pij)  # rows with no visible key yet
+            l_ref[h] = l_ref[h] * alpha + jnp.sum(pij, axis=-1)
+            acc_ref[h] = acc_ref[h] * alpha[:, None] + jax.lax.dot_general(
+                pij, vh, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+            m_ref[h] = m_new
 
     @pl.when(p == pages - 1)
     def _finish():
-        l = l_ref[...]
-        l = jnp.where(l == 0.0, 1.0, l)
-        o = acc_ref[...] / l[..., None]  # (kvh, g, s_q, d)
-        o_ref[0] = jnp.transpose(o, (2, 0, 1, 3)).reshape(s_q, kvh * g, d).astype(o_ref.dtype)
+        for h in range(kvh):
+            l = l_ref[h]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, :, pl.dslice(h * g, g), :] = (acc_ref[h] / l[:, None]).reshape(s_q, g, d).astype(o_ref.dtype)
 
 
 def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
@@ -304,9 +324,9 @@ def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.n
         ],
         out_specs=pl.BlockSpec((1, S, H, D), lambda b, p, bt, cl, q0: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((KVH, G, S, D), jnp.float32),
-            pltpu.VMEM((KVH, G, S), jnp.float32),
-            pltpu.VMEM((KVH, G, S), jnp.float32),
+            pltpu.VMEM((KVH, S * G, D), jnp.float32),
+            pltpu.VMEM((KVH, S * G), jnp.float32),
+            pltpu.VMEM((KVH, S * G), jnp.float32),
         ],
     )
     return pl.pallas_call(
@@ -314,6 +334,5 @@ def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.n
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
         interpret=interpret,
-        compiler_params=pltpu.TPUCompilerParams(dimension_semantics=("parallel", "arbitrary")) if not interpret and
-        hasattr(pltpu, "TPUCompilerParams") else None,
+        compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
     )(block_tables, ctx_lens, qpos0, q, k_pages, v_pages, slopes_in)

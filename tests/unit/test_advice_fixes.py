@@ -83,3 +83,19 @@ class TestPickChunkDivisor:
     def test_divisible_unchanged(self):
         assert _pick_chunk(1024, target=512) == 512
         assert _pick_chunk(96, target=512) == 32  # first power-of-two candidate that divides
+
+
+class TestAutoChunkBudget:
+    """Round-3 hardware A/B: chunk=S beat chunk=512 by 2.2%, so the default
+    is now the largest chunk whose fp32 logits block fits the budget."""
+
+    def test_small_batch_takes_full_sequence(self):
+        assert _pick_chunk(1024, B=8, V=50257) == 1024
+        assert _pick_chunk(1024, B=16, V=50257) == 1024
+
+    def test_large_batch_budgets_down(self):
+        c = _pick_chunk(1024, B=256, V=50257)
+        assert c < 1024 and 1024 % c == 0
+
+    def test_explicit_target_still_wins(self):
+        assert _pick_chunk(1024, target=256, B=8, V=50257) == 256

@@ -5,6 +5,7 @@ env knobs are read at import):
 Optional DS_AB_BS sets the micro-batch (default 16). Prints one line:
   VARIANT bq=..,bk=..,ce=..,bs=..: X ms/step (Y tok/s)."""
 import os, sys, time
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))  # repo root (PYTHONPATH breaks the axon plugin)
 bq, bk = sys.argv[1], sys.argv[2]
 os.environ["DS_TPU_FLASH_BQ"] = bq
 os.environ["DS_TPU_FLASH_BK"] = bk
@@ -27,5 +28,5 @@ t0=time.perf_counter()
 for _ in range(n): l,g = vg(bparams, batch)
 float(l)
 dt=(time.perf_counter()-t0)/n
-print(f"VARIANT bq={bq},bk={bk},ce={os.environ.get('DS_TPU_CE_CHUNK','512')},bs={bs}: "
+print(f"VARIANT bq={bq},bk={bk},ce={os.environ.get('DS_TPU_CE_CHUNK','auto')},bs={bs}: "
       f"{dt*1e3:.1f} ms/step ({bs*1024/dt:.0f} tok/s) [compile {comp:.0f}s]", flush=True)
