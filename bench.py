@@ -110,6 +110,40 @@ def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
     return batch * (new_tokens - half) / decode_dt
 
 
+def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
+    """v2 ragged serving throughput: continuous batching over mixed prompts.
+
+    FastGen analogue (reference ``blogs/deepspeed-fastgen/README.md:139``
+    publishes throughput-latency tables for the ragged engine): measures
+    total generated tokens/s of the serving loop — chunked-prefill
+    admission + paged decode with fused multi-step bursts — over a batch
+    of concurrent variable-length requests.
+    """
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import CausalLM
+
+    model = CausalLM(cfg_model)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    max_ctx = min(cfg_model.max_seq_len, prompt_len + new_tokens + 64)
+    # size the pool to the workload (the 4 GB memory_gb default would
+    # zero-fill pages the CPU smoke path never touches)
+    smc = RaggedBatchConfig(max_context=max_ctx)
+    smc.num_kv_blocks = n_prompts * (-(-max_ctx // smc.kv_block_size)) + 8
+    cfg = RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16")
+    eng = InferenceEngineV2(model, params, cfg)
+    rng = np.random.RandomState(0)
+    # varied prompt lengths: a ragged workload, not a lockstep batch
+    lens = rng.randint(max(4, prompt_len // 2), prompt_len + 1, size=n_prompts)
+    prompts = [rng.randint(0, cfg_model.vocab_size, size=(int(l),)).tolist() for l in lens]
+    eng.generate(prompts, max_new_tokens=new_tokens)  # compile every bucket/burst shape
+    t0 = time.perf_counter()
+    out = eng.generate(prompts, max_new_tokens=new_tokens)
+    dt = time.perf_counter() - t0
+    assert all(len(o) == new_tokens for o in out)
+    return n_prompts * new_tokens / dt
+
+
 def _probe_backend(timeout_s: float = 180.0):
     """Initialize the jax backend under a watchdog: a wedged TPU tunnel makes
     the first device query hang forever — exit loudly instead of hanging the
@@ -196,6 +230,18 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             "unit": "tokens/s/chip",
             "vs_baseline": round(tps / baseline, 4),
         }
+    if rung == "serve":
+        serve_prompts, serve_new = (32, 128) if platform == "tpu" else (3, 8)
+        tps = run_serve(jax, jnp, np, cfg_model, serve_prompts, prompt_len=decode_bs * 4, new_tokens=serve_new)
+        # same HBM-bound derivation as decode (module docstring); the serving
+        # loop additionally carries prefill + scheduling overhead
+        baseline = 25_000.0
+        return {
+            "metric": f"gpt2-125m_bf16_ragged_serve_tokens_per_sec_per_chip{tag}",
+            "value": round(tps, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(tps / baseline, 4),
+        }
     if rung == "attn":
         tfs = run_attention_ab(jax, jnp, np, platform, iters=max(iters, 3))
         if not tfs:
@@ -237,7 +283,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
 
 def main():
     rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
-    known = ("zero2", "zero3", "decode", "attn")
+    known = ("zero2", "zero3", "decode", "serve", "attn")
     if rung not in known:
         print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected {' | '.join(known)}", file=sys.stderr)
         return 1
@@ -282,6 +328,14 @@ def main():
     # item 7: zero3/decode produced no artifact) -> BENCH_extra.json
     if os.environ.get("DS_BENCH_EXTRA", "1") != "0":
         extra = {rung: primary}
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_extra.json")
+
+        def flush_extra():
+            # incremental: a driver timeout mid-rung must not lose finished rungs
+            with open(path, "w") as f:
+                json.dump(extra, f, indent=1)
+
+        flush_extra()
         for other in known:
             if other == rung:
                 continue
@@ -291,9 +345,7 @@ def main():
             except Exception as e:
                 extra[other] = {"error": f"{type(e).__name__}: {e}"}
                 print(f"[bench] extra rung {other} failed: {type(e).__name__}: {e}", file=sys.stderr)
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_extra.json")
-        with open(path, "w") as f:
-            json.dump(extra, f, indent=1)
+            flush_extra()
         print(f"[bench] wrote {path}", file=sys.stderr)
     return 0
 

@@ -25,7 +25,7 @@ import numpy as np
 
 from ...models.transformer import TransformerConfig
 from ...utils.logging import log_dist, logger
-from .model_runner import make_step_fns
+from .model_runner import make_burst_fn, make_step_fns
 from .ragged.manager import DSStateManager, RaggedBatchConfig
 from .scheduler import RaggedBatchScheduler, RaggedRequest
 
@@ -44,6 +44,7 @@ class RaggedInferenceEngineConfig:
     tensor_parallel: int = 1
     dtype: str = "bfloat16"
     interpret_kernels: Optional[bool] = None  # Pallas interpret mode; default: on unless running on real TPU
+    decode_burst: int = 32  # max fused greedy-decode steps per dispatch (0 disables bursting)
 
     @classmethod
     def from_dict(cls, d: Dict) -> "RaggedInferenceEngineConfig":
@@ -134,9 +135,10 @@ class InferenceEngineV2:
         if interpret is None:
             from ...ops.registry import pallas_available
             interpret = not pallas_available()
-        self._prefill_fn, self._decode_fn = make_step_fns(
-            run_cfg, interpret=interpret,
-            mesh=self._mesh_topo.mesh if self._mesh_topo is not None else None, tp=self._tp)
+        run_mesh = self._mesh_topo.mesh if self._mesh_topo is not None else None
+        self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp)
+        self._burst_fn = make_burst_fn(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp) \
+            if config.decode_burst >= 2 else None
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
                  f"({n_blocks * bs} cached tokens), dtype={config.dtype}", ranks=[0])
 
@@ -234,7 +236,13 @@ class InferenceEngineV2:
             return np.asarray(jnp.argmax(logits[0], axis=-1))  # device argmax, tiny readback
         return np.asarray(logits[0])
 
-    def _run_decode(self, uids: List[int], tokens: List[int], return_tokens: bool = False) -> np.ndarray:
+    def _assemble_decode(self, uids: List[int], tokens: List[int], steps: int):
+        """Shared decode-batch assembly for single steps and bursts.
+
+        Allocates ``steps`` KV tokens per sequence and builds the padded
+        (ids, positions, ctx, block tables, (steps, B) slot table, last)
+        arrays; padded rows write every step's KV into the garbage page.
+        """
         n = len(uids)
         B = _next_pow2(n)
         bs = self.state.block_size
@@ -242,29 +250,66 @@ class InferenceEngineV2:
         positions = np.zeros((B, 1), np.int32)
         ctx = np.zeros((B,), np.int32)
         bt = np.full((B, self._max_blocks_per_seq), self._garbage_block, np.int32)
-        slots = self._garbage_slots(B)
+        slots = np.tile(self._garbage_slots(B)[None], (steps, 1))
         seqs = []
+        step_idx = np.arange(steps)
         for j, (uid, tok) in enumerate(zip(uids, tokens)):
             seq = self.state.get_sequence(uid)
-            self.state.allocate_for(seq, 1)
-            seq.pre_forward(1)
-            pos = seq.seen_tokens
+            self.state.allocate_for(seq, steps)
+            seq.pre_forward(steps)
+            pos0 = seq.seen_tokens
             ids[j, 0] = tok
-            positions[j, 0] = pos
-            ctx[j] = pos + 1
+            positions[j, 0] = pos0
+            ctx[j] = pos0 + 1
             bt[j] = self._seq_block_row(seq)
-            slots[j] = seq.blocks[pos // bs] * bs + pos % bs
+            p = pos0 + step_idx
+            slots[:, j] = np.asarray(seq.blocks, np.int32)[p // bs] * bs + p % bs
             seqs.append(seq)
         last = np.zeros((B,), np.int32)
+        return ids, positions, ctx, bt, slots, last, seqs, n
 
+    def _run_decode(self, uids: List[int], tokens: List[int], return_tokens: bool = False) -> np.ndarray:
+        ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps=1)
         logits, self.k_pages, self.v_pages = self._decode_fn(self.params, jnp.asarray(ids), jnp.asarray(positions),
                                                              self.k_pages, self.v_pages, jnp.asarray(bt),
-                                                             jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last))
+                                                             jnp.asarray(ctx), jnp.asarray(slots[0]),
+                                                             jnp.asarray(last))
         for seq in seqs:
             seq.post_forward()
         if return_tokens:
             return np.asarray(jnp.argmax(logits[:n], axis=-1))  # device argmax, tiny readback
         return np.asarray(logits[:n])
+
+    def _burst_steps(self, live: Dict[int, int], remaining: int) -> int:
+        """Largest power-of-two burst length every live sequence can take.
+
+        Powers of two keep the number of distinct (B, steps) compiles to a
+        log ladder. 0 means burst is not worthwhile/feasible.
+        """
+        if self._burst_fn is None or not live:
+            return 0
+        cap = min(remaining, self._config.decode_burst,
+                  *(self._config.state_manager.max_context - self.state.get_sequence(u).seen_tokens
+                    for u in live))
+        k = 1
+        while k * 2 <= cap:
+            k *= 2
+        while k >= 2:
+            need = sum(self.state.get_sequence(u).blocks_needed(k) for u in live)
+            if self.state.can_allocate(need):
+                return k
+            k //= 2
+        return 0
+
+    def _run_decode_burst(self, uids: List[int], tokens: List[int], steps: int) -> np.ndarray:
+        """``steps`` fused greedy-decode steps; returns (len(uids), steps) tokens."""
+        ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps)
+        toks, self.k_pages, self.v_pages = self._burst_fn(
+            self.params, jnp.asarray(ids), jnp.asarray(positions), self.k_pages, self.v_pages,
+            jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last))
+        for seq in seqs:
+            seq.post_forward()
+        return np.asarray(toks[:n])
 
     # ---------------------------------------------------------- serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
@@ -280,7 +325,38 @@ class InferenceEngineV2:
         decode_ready: Dict[int, int] = {}  # uid -> next token to feed
         results: Dict[int, List[int]] = {i: [] for i in reqs}
 
+        def commit(uid: int, toks_out: List[int]) -> None:
+            """Record sampled tokens and retire/continue the request."""
+            req = reqs[uid]
+            if eos_token_id is not None and eos_token_id in toks_out:
+                toks_out = toks_out[:toks_out.index(eos_token_id) + 1]
+            results[uid].extend(toks_out)
+            done = (len(results[uid]) >= req.max_new_tokens or
+                    (eos_token_id is not None and toks_out[-1] == eos_token_id))
+            if done:
+                req.done = True
+                self.flush([uid])
+            else:
+                decode_ready[uid] = toks_out[-1]
+
         while pending or decode_ready:
+            # Burst path: nothing left to admit and everyone is decoding —
+            # run K fused steps on-device instead of K host roundtrips.
+            # A sequence that hits EOS mid-burst wastes its tail steps
+            # (tokens past EOS are discarded and its pages are flushed).
+            if not pending and decode_ready:
+                # respect the scheduler's per-step caps: a burst step decodes
+                # one token per sequence, so both limits bound the batch
+                cap = min(self.scheduler.max_sequences, self.scheduler.max_batch_tokens)
+                burst_uids = list(decode_ready)[:cap]
+                rem = min(reqs[u].max_new_tokens - len(results[u]) for u in burst_uids)
+                k = self._burst_steps({u: decode_ready[u] for u in burst_uids}, rem)
+                if k >= 2:
+                    uids = burst_uids
+                    out = self._run_decode_burst(uids, [decode_ready.pop(u) for u in uids], k)
+                    for uid, row in zip(uids, out):
+                        commit(uid, row.tolist())
+                    continue
             step = self.scheduler.schedule([r for r in pending if r.remaining_prefill], list(decode_ready))
             if step.empty:
                 raise RuntimeError("scheduler deadlock: no work schedulable (KV pool too small?)")
@@ -295,15 +371,8 @@ class InferenceEngineV2:
                 req.tokens = req.tokens[len(pf.tokens):]
             nxt = self.put(uids, toks, return_tokens=True)
             for uid, tok in zip(uids, nxt):
-                req = reqs[uid]
-                if req.remaining_prefill:
+                if reqs[uid].remaining_prefill:
                     continue  # mid-prefill chunk: logits not a sampled token yet
-                results[uid].append(int(tok))
-                done = len(results[uid]) >= req.max_new_tokens or (eos_token_id is not None and tok == eos_token_id)
-                if done:
-                    req.done = True
-                    self.flush([uid])
-                else:
-                    decode_ready[uid] = int(tok)
+                commit(uid, [int(tok)])
             pending = [r for r in pending if not r.done and r.remaining_prefill]
         return [results[i] for i in range(len(prompts))]

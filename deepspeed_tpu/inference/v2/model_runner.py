@@ -142,3 +142,37 @@ def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
     decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp),
                      donate_argnums=(3, 4), static_argnames=())
     return prefill, decode
+
+
+def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
+    """Jitted multi-step fused greedy decode.
+
+    Runs ``steps`` paged-decode steps entirely on device under one
+    dispatch: each step's device-side argmax feeds the next step's input
+    ids, positions/context lengths advance in-graph, and the per-step KV
+    slots arrive precomputed because the host allocates blocks for the
+    whole burst up front. Returns the (B, steps) greedy tokens plus the
+    updated page pool.
+
+    The reference hides per-step launch latency with CUDA-graph replay
+    (``inference/engine.py:524``) and an async scheduler in front of
+    ``engine_v2.py:107``; the TPU-native form is one compiled
+    ``lax.scan`` program, which also amortizes the host<->device readback
+    to ``1/steps`` of a token per step.
+    """
+    fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp)
+
+    def burst(params, ids0, positions0, k_pages, v_pages, block_tables, ctx0, slots, last):
+        # ids0/positions0 (B, 1); ctx0/last (B,); slots (steps, B)
+        def step(carry, slots_t):
+            ids, kp, vp, off = carry
+            logits, kp, vp = fwd(params, ids, positions0 + off, kp, vp, block_tables,
+                                 ctx0 + off, slots_t, last)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return (nxt[:, None], kp, vp, off + 1), nxt
+
+        carry0 = (ids0, k_pages, v_pages, jnp.int32(0))
+        (_, k_pages, v_pages, _), toks = jax.lax.scan(step, carry0, slots)
+        return toks.T, k_pages, v_pages
+
+    return jax.jit(burst, donate_argnums=(3, 4))

@@ -151,6 +151,52 @@ class TestEngineV2:
         assert out == _dense_generate(model, params, [5, 9, 2], 5)
 
 
+# ------------------------------------------------------------------ fused decode bursts
+class TestDecodeBurst:
+    """Multi-step fused greedy decode (``engine_v2._run_decode_burst``)."""
+
+    def test_burst_matches_stepwise(self, v2_setup):
+        import dataclasses
+        model, params, cfg = v2_setup
+        prompts = [[3, 17, 42], [7, 7, 7, 7, 7], [100, 2]]
+        ref = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=0)) \
+            .generate(prompts, max_new_tokens=9)
+        eng = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=8))
+        calls = []
+        orig = eng._run_decode
+        eng._run_decode = lambda *a, **kw: (calls.append(1), orig(*a, **kw))[1]
+        out = eng.generate(prompts, max_new_tokens=9)
+        assert out == ref
+        # 9 new tokens = prefill + 8-step burst: no single-step decodes at all
+        assert not calls
+
+    def test_eos_mid_burst_truncates_and_frees(self, v2_setup):
+        import dataclasses
+        model, params, cfg = v2_setup
+        prompt = [3, 17, 42, 9]
+        full = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=8)) \
+            .generate([prompt], max_new_tokens=9)[0]
+        eos = full[4]  # a token the model emits mid-burst
+        eng = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=8))
+        free0 = eng.state.free_blocks
+        out = eng.generate([prompt], max_new_tokens=9, eos_token_id=eos)[0]
+        assert out == full[:full.index(eos) + 1]
+        assert eng.state.free_blocks == free0  # flushed despite early EOS
+
+    def test_burst_respects_kv_pressure(self, v2_setup):
+        """With a pool too small for a full burst the ladder shrinks (or
+        falls back to single steps) instead of failing allocation."""
+        import dataclasses
+        model, params, _ = v2_setup
+        cfg = RaggedInferenceEngineConfig(
+            state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64, num_kv_blocks=4),
+            dtype="float32", decode_burst=32)
+        eng = InferenceEngineV2(model, params, cfg)
+        prompt = [3, 17, 42, 9]
+        out = eng.generate([prompt], max_new_tokens=12)[0]
+        assert out == _dense_generate(model, params, prompt, 12)
+
+
 # ------------------------------------------------------------------ MoE + TP serving
 def _moe_model():
     # GQA + MoE; generous capacity so the training-path oracle drops nothing

@@ -113,8 +113,31 @@ def _ops():
             err = float(jnp.max(jnp.abs(o - x)))
             assert err < (0.1 if bits == 8 else 1.0), (bits, err)
 
+    def serve():
+        # v2 ragged engine end-to-end on the chip: chunked prefill + paged
+        # decode + fused multi-step bursts, parity vs the dense forward
+        from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig,
+                                                RaggedInferenceEngineConfig)
+        from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+        cfg = TransformerConfig(vocab_size=256, n_layers=2, n_heads=4, n_kv_heads=2, d_model=64, max_seq_len=128,
+                                norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False)
+        model = CausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            state_manager=RaggedBatchConfig(kv_block_size=16, max_context=128, num_kv_blocks=32), dtype="float32"))
+        prompts = [[3, 17, 42, 9], [7, 7, 7], [100, 2, 5, 8, 13, 21]]
+        outs = eng.generate(prompts, max_new_tokens=10)
+        for p, o in zip(prompts, outs):
+            toks = list(p)
+            for t in range(10):
+                logits = model.apply(params, jnp.asarray([toks], jnp.int32))
+                nxt = int(jnp.argmax(logits[0, -1]))
+                assert o[t] == nxt, (p, t, o[t], nxt)
+                toks.append(nxt)
+
     return {"flash": flash, "sparse": sparse, "paged": paged, "norms": norms,
-            "optimizers": optimizers, "quant": quant}
+            "optimizers": optimizers, "quant": quant, "serve": serve}
 
 
 def main():
