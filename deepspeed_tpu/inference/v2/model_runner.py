@@ -65,7 +65,7 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         # slope slices aren't expressible as a baked constant, so ALiBi/
         # window models route through the gather path under TP.
         decode_attn = shard_map(
-            functools.partial(paged_attention_decode, interpret=interpret),
+            functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale),
             mesh=mesh, in_specs=(P(None, "tensor", None), P(None, None, "tensor", None),
                                  P(None, None, "tensor", None), P(None, None), P(None)),
             out_specs=P(None, "tensor", None), check_vma=False)
@@ -73,14 +73,14 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         prefill_attn = None
     else:
         decode_attn = functools.partial(
-            paged_attention_decode, interpret=interpret,
+            paged_attention_decode, interpret=interpret, scale=cfg.attn_scale,
             alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
             window=cfg.sliding_window)
         # interpret mode (CPU dev serving) keeps the compute-bound prefill on
         # the fused XLA gather path — emulating the page-walk kernel there is
         # strictly slower; on real TPU the kernel avoids the context gather
         prefill_attn = None if interpret else functools.partial(
-            paged_attention_prefill,
+            paged_attention_prefill, scale=cfg.attn_scale,
             alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
             window=cfg.sliding_window)
         decode_native = True

@@ -101,8 +101,8 @@ def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, pos
         return decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
     if not decode and prefill_attn is not None and native:
         return prefill_attn(q, kp, vp, block_tables, ctx_lens, positions)
-    return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, alibi_slopes=slopes,
-                               window=cfg.sliding_window)
+    return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, scale=cfg.attn_scale,
+                               alibi_slopes=slopes, window=cfg.sliding_window)
 
 
 def mlp_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):

@@ -55,6 +55,11 @@ class TransformerConfig:
     embed_scale: bool = False  # gemma: scale embeddings by sqrt(d_model)
     rms_offset: bool = False  # gemma: rmsnorm weights stored zero-centered, applied as (1 + w)
     sliding_window: Optional[int] = None  # mistral: query i attends keys in (i - w, i]
+    # per-layer window selection: tuple of layer indices that apply
+    # ``sliding_window``; None = every layer (gpt-neo alternating
+    # global/local layers, qwen2 ``max_window_layers`` suffix windows)
+    window_layers: Optional[Tuple[int, ...]] = None
+    attn_scale: Optional[float] = None  # softmax scale override; None = 1/sqrt(head_dim) (gpt-neo: 1.0)
     # encoder family (BERT): bidirectional attention, post-LN blocks,
     # token-type embeddings, MLM transform head (ref module_inject/containers/bert.py)
     causal: bool = True  # False: bidirectional encoder
@@ -105,6 +110,21 @@ class TransformerConfig:
     @property
     def use_attn_out_bias(self) -> bool:
         return self.use_dense_bias if self.attn_out_bias is None else self.attn_out_bias
+
+    def window_for(self, layer_idx: int) -> Optional[int]:
+        """Sliding-window width for one layer (None = full attention)."""
+        if self.sliding_window is None:
+            return None
+        if self.window_layers is None:
+            return self.sliding_window
+        return self.sliding_window if layer_idx in self.window_layers else None
+
+    @property
+    def uniform_window(self) -> bool:
+        """True when every layer shares one window config (scan/v2-servable)."""
+        if self.sliding_window is None or self.window_layers is None:
+            return True
+        return set(self.window_layers) in (set(), set(range(self.n_layers)))
 
     @property
     def rotary_dim(self) -> int:
@@ -219,6 +239,7 @@ def alibi_slopes(n_heads: int) -> np.ndarray:
 
 class Attention(nn.Module):
     cfg: TransformerConfig
+    layer_idx: int = 0
 
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, segment_ids=None):
@@ -253,7 +274,7 @@ class Attention(nn.Module):
 
         slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
         out = attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids, kv_len=kv_len,
-                        alibi_slopes=slopes, window=cfg.sliding_window)
+                        alibi_slopes=slopes, window=cfg.window_for(self.layer_idx), scale=cfg.attn_scale)
         out = nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=cfg.use_attn_out_bias, name="o_proj",
                               dtype=cfg.dtype, param_dtype=jnp.float32)(out)
         return (out, new_cache) if kv_cache is not None else out
@@ -303,7 +324,7 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, kv_cache=None, segment_ids=None):
         cfg = self.cfg
-        attn = Attention(cfg, name="attn")
+        attn = Attention(cfg, layer_idx=self.layer_idx, name="attn")
 
         def run_attn(h):
             if kv_cache is not None:
@@ -342,6 +363,8 @@ class Transformer(nn.Module):
         train = (kv_caches is None) if train is None else bool(train)
         if pld_theta is not None and cfg.scan_layers:
             raise ValueError("progressive layer drop needs the unrolled layer loop: set scan_layers=False")
+        if cfg.scan_layers and not cfg.uniform_window:
+            raise ValueError("per-layer window_layers needs heterogeneous blocks: set scan_layers=False")
         B, S = input_ids.shape
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
@@ -516,6 +539,11 @@ class CausalLM:
             raise NotImplementedError("MoE + pipeline composition lands with expert-parallel pipeline support")
         if cfg.scan_layers:
             raise ValueError("disable scan_layers for pipeline (stages are stacked instead)")
+        if not cfg.uniform_window:
+            # stage_fn applies ONE Block(layer_idx=0) to every stacked layer;
+            # per-layer windows would silently take layer 0's window everywhere
+            raise NotImplementedError("per-layer window_layers models are not pipeline-partitionable "
+                                      "(stages share one block program)")
         if cfg.embedding_norm:
             raise NotImplementedError("embedding_norm (bloom) models are not pipeline-partitionable yet")
         if cfg.norm == "layernorm_np":

@@ -149,7 +149,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("layer_norm_epsilon", 1e-5),
             dtype=dtype,
         )
-    elif model_type in ("llama", "mistral", "qwen2", "qwen3", "mixtral", ""):
+    elif model_type in ("llama", "mistral", "qwen2", "qwen3", "mixtral", "internlm", ""):
         kw = dict(
             vocab_size=hf["vocab_size"],
             n_layers=hf.get("num_hidden_layers", 2),
@@ -168,25 +168,32 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         )
         if model_type == "qwen2":
             kw["qkv_bias"] = True
+        if model_type == "llama" and hf.get("attention_bias"):
+            kw["qkv_bias"] = True
+            kw["attn_out_bias"] = True
+        if model_type == "internlm":
+            # ref module_inject/containers/internlm.py: llama layout with
+            # config.bias toggling biases on q/k/v AND o (no HF-native class;
+            # converter exercised via the shared llama machinery)
+            kw["qkv_bias"] = bool(hf.get("bias", False))
+            kw["attn_out_bias"] = bool(hf.get("bias", False))
         if model_type == "qwen3":
             kw["qk_norm"] = True
             if hf.get("head_dim"):
                 kw["head_dims"] = int(hf["head_dim"])
         if model_type in ("mistral", "mixtral") and hf.get("sliding_window"):
             kw["sliding_window"] = int(hf["sliding_window"])
-        # qwen2 gates its window behind use_sliding_window, and HF applies it
-        # only to layers with idx >= max_window_layers; one global window can
-        # express the all-layers (mwl <= 0) and no-layers (mwl >= n_layers)
-        # cases — mixed per-layer configs are rejected rather than mis-served
+        # qwen2 gates its window behind use_sliding_window; HF windows only
+        # layers with idx >= max_window_layers (the first mwl layers attend
+        # fully) — expressed with per-layer window_layers
         if model_type in ("qwen2", "qwen3") and hf.get("use_sliding_window") and hf.get("sliding_window"):
             mwl = int(hf.get("max_window_layers", 28))  # HF Qwen2Config default
             n_layers = kw["n_layers"]
             if mwl <= 0:
                 kw["sliding_window"] = int(hf["sliding_window"])
             elif mwl < n_layers:
-                raise NotImplementedError(
-                    f"qwen2 max_window_layers={mwl} windows only a suffix of the {n_layers} layers; "
-                    "per-layer window mixing is unsupported")
+                kw["sliding_window"] = int(hf["sliding_window"])
+                kw["window_layers"] = tuple(range(mwl, n_layers))
             # mwl >= n_layers: HF uses full attention everywhere — no window
         if model_type == "mixtral":
             kw.update(
@@ -430,6 +437,64 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
             norm_eps=hf.get("layer_norm_eps", 1e-12),
             dtype=dtype,
         )
+    elif model_type == "gpt_neo":
+        # ref module_inject/containers/gptneo.py (HFGPTNEOLayerPolicy):
+        # gpt2-style learned positions but torch-Linear projections, bias-free
+        # q/k/v, UNSCALED attention logits, and alternating global/local
+        # (window 256) layers via attention_layers
+        d_model = hf.get("hidden_size", 2048)
+        n_layers = hf.get("num_layers", 24)
+        att_layers = hf.get("attention_layers")
+        if not att_layers:  # expand [["global","local"], 12]-style attention_types
+            att_layers = []
+            for kinds, n in hf.get("attention_types") or [[["global"], n_layers]]:
+                att_layers += list(kinds) * n
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=n_layers,
+            n_heads=hf.get("num_heads", 16),
+            d_model=d_model,
+            d_ff=hf.get("intermediate_size") or 4 * d_model,
+            max_seq_len=hf.get("max_position_embeddings", 2048),
+            norm="layernorm",
+            activation=_map_gelu(hf.get("activation_function", "gelu_new")),
+            pos_emb="learned",
+            qkv_bias=False,
+            attn_scale=1.0,
+            tie_embeddings=hf.get("tie_word_embeddings", True),
+            norm_eps=hf.get("layer_norm_epsilon", 1e-5),
+            dtype=dtype,
+        )
+        local = tuple(i for i, kind in enumerate(att_layers[:n_layers]) if kind == "local")
+        if local:
+            kw["sliding_window"] = int(hf.get("window_size", 256))
+            if len(local) < n_layers:
+                kw["window_layers"] = local
+    elif model_type == "distilbert":
+        # ref module_inject/containers/distil_bert.py (HFDistilBertLayerPolicy):
+        # BERT post-LN encoder minus token-type embeddings; MLM head =
+        # vocab_transform -> gelu -> vocab_layer_norm -> tied projector
+        kw = dict(
+            vocab_size=hf["vocab_size"],
+            n_layers=hf.get("n_layers", 6),
+            n_heads=hf.get("n_heads", 12),
+            d_model=hf.get("dim", 768),
+            d_ff=hf.get("hidden_dim", 3072),
+            max_seq_len=hf.get("max_position_embeddings", 512),
+            norm="layernorm",
+            activation=_map_gelu(hf.get("activation", "gelu")),
+            pos_emb="learned",
+            causal=False,
+            norm_scheme="post",
+            embedding_norm=True,
+            type_vocab_size=0,
+            mlm_head=True,
+            tie_embeddings=True,
+            norm_eps=1e-12,  # hardcoded in HF DistilBert LayerNorms
+            dtype=dtype,
+        )
+        if hf.get("sinusoidal_pos_embds"):
+            raise NotImplementedError("distilbert sinusoidal_pos_embds unsupported (learned positions only)")
     elif model_type == "bloom":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -448,7 +513,8 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         )
     else:
         raise NotImplementedError(f"HF model_type '{model_type}' not supported (supported: gpt2, llama, "
-                                  "mistral, qwen2, mixtral, opt, gpt_neox, gptj, falcon, phi, bloom)")
+                                  "mistral, qwen2, qwen3, mixtral, opt, gpt_neox, gptj, gpt_neo, falcon, phi, "
+                                  "phi3, bloom, gpt_bigcode, gemma, stablelm, olmo, bert, distilbert)")
     kw.update(overrides)
     return TransformerConfig(**kw)
 
@@ -571,11 +637,13 @@ def convert_llama(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
         if cfg.qk_norm:  # qwen3 per-head q/k norms
             layer["attn"]["q_norm"] = {"scale": sd[p + "self_attn.q_norm.weight"]}
             layer["attn"]["k_norm"] = {"scale": sd[p + "self_attn.k_norm.weight"]}
-        # qwen2 carries attention biases
+        # qwen2 carries q/k/v biases; internlm (config.bias) also biases o
         for proj, heads in (("q_proj", H), ("k_proj", KVH), ("v_proj", KVH)):
             bkey = p + f"self_attn.{proj}.bias"
             if bkey in sd:
                 layer["attn"][proj]["bias"] = sd[bkey].reshape(heads, D)
+        if p + "self_attn.o_proj.bias" in sd:
+            layer["attn"]["o_proj"]["bias"] = sd[p + "self_attn.o_proj.bias"]
         params[f"layer_{i}"] = layer
     return params
 
@@ -904,6 +972,78 @@ def convert_bert(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     return params
 
 
+def convert_gpt_neo(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``GPTNeoForCausalLM`` -> pytree. gpt2 layout but torch Linear
+    (out, in) projections (transposed) with bias-free q/k/v."""
+    sd = _strip_prefix(sd)
+    H, D, dm = cfg.n_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["wte.weight"],
+        "wpe": sd["wpe.weight"][:cfg.max_seq_len],
+        ln(0): {"scale": sd["ln_f.weight"], "bias": sd["ln_f.bias"]},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": sd["lm_head.weight"].T}
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        a = p + "attn.attention."
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "ln_1.weight"], "bias": sd[p + "ln_1.bias"]},
+            ln(1): {"scale": sd[p + "ln_2.weight"], "bias": sd[p + "ln_2.bias"]},
+            "attn": {
+                "q_proj": {"kernel": sd[a + "q_proj.weight"].T.reshape(dm, H, D)},
+                "k_proj": {"kernel": sd[a + "k_proj.weight"].T.reshape(dm, H, D)},
+                "v_proj": {"kernel": sd[a + "v_proj.weight"].T.reshape(dm, H, D)},
+                "o_proj": {"kernel": sd[a + "out_proj.weight"].T.reshape(H, D, dm),
+                           "bias": sd[a + "out_proj.bias"]},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "mlp.c_fc.weight"].T, "bias": sd[p + "mlp.c_fc.bias"]},
+                "down_proj": {"kernel": sd[p + "mlp.c_proj.weight"].T, "bias": sd[p + "mlp.c_proj.bias"]},
+            },
+        }
+    return params
+
+
+def convert_distilbert(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
+    """HF ``DistilBertForMaskedLM`` -> encoder pytree (BERT minus token-type
+    embeddings; ``vocab_transform``/``vocab_layer_norm`` MLM head with the
+    projector tied to the word embeddings)."""
+    sd = _strip_prefix(sd, prefixes=("distilbert.",))
+    H, D, dm = cfg.n_heads, cfg.head_dim, cfg.d_model
+    ln = lambda i: _norm_name(cfg, i)
+    params: Dict[str, Any] = {
+        "wte": sd["embeddings.word_embeddings.weight"],
+        "wpe": sd["embeddings.position_embeddings.weight"][:cfg.max_seq_len],
+        ln(0): {"scale": sd["embeddings.LayerNorm.weight"], "bias": sd["embeddings.LayerNorm.bias"]},
+        "mlm_dense": {"kernel": sd["vocab_transform.weight"].T, "bias": sd["vocab_transform.bias"]},
+        ln(1): {"scale": sd["vocab_layer_norm.weight"], "bias": sd["vocab_layer_norm.bias"]},
+        "mlm_bias": sd["vocab_projector.bias"],
+    }
+    for i in range(cfg.n_layers):
+        p = f"transformer.layer.{i}."
+        params[f"layer_{i}"] = {
+            ln(0): {"scale": sd[p + "sa_layer_norm.weight"], "bias": sd[p + "sa_layer_norm.bias"]},
+            ln(1): {"scale": sd[p + "output_layer_norm.weight"], "bias": sd[p + "output_layer_norm.bias"]},
+            "attn": {
+                "q_proj": {"kernel": sd[p + "attention.q_lin.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "attention.q_lin.bias"].reshape(H, D)},
+                "k_proj": {"kernel": sd[p + "attention.k_lin.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "attention.k_lin.bias"].reshape(H, D)},
+                "v_proj": {"kernel": sd[p + "attention.v_lin.weight"].T.reshape(dm, H, D),
+                           "bias": sd[p + "attention.v_lin.bias"].reshape(H, D)},
+                "o_proj": {"kernel": sd[p + "attention.out_lin.weight"].T.reshape(H, D, dm),
+                           "bias": sd[p + "attention.out_lin.bias"]},
+            },
+            "mlp": {
+                "up_proj": {"kernel": sd[p + "ffn.lin1.weight"].T, "bias": sd[p + "ffn.lin1.bias"]},
+                "down_proj": {"kernel": sd[p + "ffn.lin2.weight"].T, "bias": sd[p + "ffn.lin2.bias"]},
+            },
+        }
+    return params
+
+
 def convert_bloom(sd: Dict[str, np.ndarray], cfg: TransformerConfig) -> Dict:
     """HF ``BloomForCausalLM`` -> pytree: ALiBi attention, embedding
     layernorm, per-head-interleaved fused qkv (H, 3, D)."""
@@ -950,6 +1090,8 @@ _CONVERTERS = {
     "gpt_bigcode": convert_gpt_bigcode,
     "phi3": convert_phi3,
     "bert": convert_bert,
+    "gpt_neo": convert_gpt_neo,
+    "distilbert": convert_distilbert,
 }
 
 

@@ -456,3 +456,60 @@ def test_bert_tp2_serving(tmp_path):
         ref = tm(torch.from_numpy(np.asarray(IDS, np.int64))).logits.numpy()
     got = np.asarray(eng.forward(IDS))
     np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_gpt_neo_logits_match(tmp_path):
+    """Alternating global/local attention layers, bias-free q/k/v, UNSCALED
+    attention logits (ref module_inject/containers/gptneo.py)."""
+    cfg = transformers.GPTNeoConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                    max_position_embeddings=64, window_size=4,
+                                    attention_types=[[["global", "local"], 1]])
+    torch.manual_seed(14)
+    model, _ = _roundtrip(tmp_path, transformers.GPTNeoForCausalLM(cfg), IDS)
+    assert model.cfg.attn_scale == 1.0 and model.cfg.sliding_window == 4
+    assert model.cfg.window_layers == (1,)
+    assert model.cfg.window_for(0) is None and model.cfg.window_for(1) == 4
+
+
+def test_gpt_neo_all_global(tmp_path):
+    """All-global attention_types: no window, plain gpt2-style stack."""
+    cfg = transformers.GPTNeoConfig(vocab_size=128, hidden_size=64, num_layers=2, num_heads=4,
+                                    max_position_embeddings=64, attention_types=[[["global"], 2]])
+    torch.manual_seed(15)
+    model, _ = _roundtrip(tmp_path, transformers.GPTNeoForCausalLM(cfg), IDS)
+    assert model.cfg.sliding_window is None and model.cfg.uniform_window
+
+
+def test_distilbert_logits_match(tmp_path):
+    """BERT-minus-token-types encoder with the vocab_transform MLM head
+    (ref module_inject/containers/distil_bert.py)."""
+    cfg = transformers.DistilBertConfig(vocab_size=128, dim=64, hidden_dim=128, n_layers=2,
+                                        n_heads=4, max_position_embeddings=64)
+    torch.manual_seed(16)
+    model, _ = _roundtrip(tmp_path, transformers.DistilBertForMaskedLM(cfg), IDS)
+    assert not model.cfg.causal and model.cfg.norm_scheme == "post"
+    assert model.cfg.mlm_head and model.cfg.type_vocab_size == 0
+
+
+def test_qwen2_suffix_window_logits_match(tmp_path):
+    """qwen2 max_window_layers windows only layers idx >= mwl; per-layer
+    window_layers serves the mixed stack exactly."""
+    cfg = transformers.Qwen2Config(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                   num_hidden_layers=3, num_attention_heads=4, num_key_value_heads=4,
+                                   max_position_embeddings=64, use_sliding_window=True,
+                                   sliding_window=4, max_window_layers=1)
+    torch.manual_seed(17)
+    model, _ = _roundtrip(tmp_path, transformers.Qwen2ForCausalLM(cfg), IDS)
+    assert model.cfg.sliding_window == 4 and model.cfg.window_layers == (1, 2)
+
+
+def test_llama_attention_bias_logits_match(tmp_path):
+    """attention_bias=True biases q/k/v AND o — the internlm layout
+    (ref module_inject/containers/internlm.py); oracle via LlamaForCausalLM."""
+    cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                   num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=4,
+                                   max_position_embeddings=64, attention_bias=True)
+    torch.manual_seed(18)
+    model, params = _roundtrip(tmp_path, transformers.LlamaForCausalLM(cfg), IDS)
+    assert model.cfg.use_qkv_bias and model.cfg.use_attn_out_bias
+    assert "bias" in params["layer_0"]["attn"]["o_proj"]

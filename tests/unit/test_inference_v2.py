@@ -150,6 +150,35 @@ class TestEngineV2:
         out = eng.generate([[5, 9, 2]], max_new_tokens=5)[0]
         assert out == _dense_generate(model, params, [5, 9, 2], 5)
 
+    def test_attn_scale_model(self):
+        """gpt-neo all-global: UNSCALED attention (attn_scale=1.0) must flow
+        into the paged decode/prefill paths, not just the dense model."""
+        cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=2, d_model=16, max_seq_len=64, norm="layernorm",
+                                activation="gelu", pos_emb="learned", tie_embeddings=True, qkv_bias=False,
+                                attn_scale=1.0)
+        model = CausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(2), {"input_ids": np.zeros((1, 8), np.int32)})
+        eng = InferenceEngineV2(
+            model, params,
+            RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
+                                                                        num_kv_blocks=32), dtype="float32"))
+        out = eng.generate([[5, 9, 2, 44]], max_new_tokens=5)[0]
+        assert out == _dense_generate(model, params, [5, 9, 2, 44], 5)
+
+    def test_window_layers_rejected(self):
+        """Mixed global/local stacks (gpt-neo) must be refused, not mis-served."""
+        import pytest as _pytest
+
+        cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=2, d_model=16, max_seq_len=64, norm="layernorm",
+                                activation="gelu", pos_emb="learned", sliding_window=4, window_layers=(1,))
+        model = CausalLM(cfg)
+        params = model.init(jax.random.PRNGKey(3), {"input_ids": np.zeros((1, 8), np.int32)})
+        with _pytest.raises(NotImplementedError, match="window_layers"):
+            InferenceEngineV2(
+                model, params,
+                RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
+                                                                            num_kv_blocks=32), dtype="float32"))
+
 
 # ------------------------------------------------------------------ fused decode bursts
 class TestDecodeBurst:
