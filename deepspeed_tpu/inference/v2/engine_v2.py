@@ -97,6 +97,22 @@ class InferenceEngineV2:
             log_dist(f"max_context {smc.max_context} > model max_seq_len {cfg.max_seq_len}; capping", ranks=[0])
             smc = dataclasses.replace(smc, max_context=cfg.max_seq_len)
             config.state_manager = smc
+        run_cfg = dataclasses.replace(cfg, dtype=self.dtype)
+        if run_cfg.window_layers is not None and len(run_cfg.window_layers) == 0:
+            # window_for() applies no window anywhere, but the paged runner
+            # reads sliding_window directly — normalize so they agree
+            run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
+        if run_cfg.sliding_window is not None and run_cfg.sliding_window >= smc.max_context:
+            # the window can never mask inside this engine's context budget;
+            # dropping it keeps decode on the Pallas paged kernel
+            run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
+        if not run_cfg.uniform_window:
+            # the paged runner applies ONE window to every layer; serving a
+            # mixed global/local stack (gpt-neo) here would silently mask
+            # wrong — route such models through the v1 engine instead. Raised
+            # BEFORE the KV pools allocate (no throwaway device memory).
+            raise NotImplementedError("per-layer window_layers models are not servable by the ragged "
+                                      "v2 engine (one window per model); use the v1 engine")
         n_blocks = smc.num_kv_blocks
         if n_blocks is None:
             bytes_per_block = (2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * cfg.head_dim *
@@ -116,21 +132,6 @@ class InferenceEngineV2:
         self._max_blocks_per_seq = -(-smc.max_context // bs)
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
-        run_cfg = dataclasses.replace(cfg, dtype=self.dtype)
-        if run_cfg.window_layers is not None and len(run_cfg.window_layers) == 0:
-            # window_for() applies no window anywhere, but the paged runner
-            # reads sliding_window directly — normalize so they agree
-            run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
-        if run_cfg.sliding_window is not None and run_cfg.sliding_window >= smc.max_context:
-            # the window can never mask inside this engine's context budget;
-            # dropping it keeps decode on the Pallas paged kernel
-            run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
-        if not run_cfg.uniform_window:
-            # the paged runner applies ONE window to every layer; serving a
-            # mixed global/local stack (gpt-neo) here would silently mask
-            # wrong — route such models through the v1 engine instead
-            raise NotImplementedError("per-layer window_layers models are not servable by the ragged "
-                                      "v2 engine (one window per model); use the v1 engine")
         self.params = jax.tree_util.tree_map(cast, params)
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P

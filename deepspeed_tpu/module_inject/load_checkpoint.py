@@ -513,8 +513,9 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
         )
     else:
         raise NotImplementedError(f"HF model_type '{model_type}' not supported (supported: gpt2, llama, "
-                                  "mistral, qwen2, qwen3, mixtral, opt, gpt_neox, gptj, gpt_neo, falcon, phi, "
-                                  "phi3, bloom, gpt_bigcode, gemma, stablelm, olmo, bert, distilbert)")
+                                  "mistral, qwen2, qwen3, mixtral, internlm, opt, gpt_neox, gptj, gpt_neo, "
+                                  "falcon, phi, phi3, bloom, gpt_bigcode, gemma, stablelm, olmo, bert, "
+                                  "distilbert)")
     kw.update(overrides)
     return TransformerConfig(**kw)
 

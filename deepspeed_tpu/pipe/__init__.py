@@ -1,0 +1,7 @@
+"""Top-level ``deepspeed_tpu.pipe`` — the reference's ``deepspeed.pipe``
+re-export shim (``deepspeed/pipe/__init__.py``): ``PipelineModule``,
+``LayerSpec``, ``TiedLayerSpec`` plus the schedule taxonomy.
+"""
+
+from ..runtime.pipe import *  # noqa: F401,F403
+from ..runtime.pipe import __all__  # noqa: F401

@@ -1,0 +1,8 @@
+"""Top-level ``deepspeed_tpu.zero`` — the reference's ``deepspeed.zero``
+package (``deepspeed/runtime/zero/__init__.py`` re-exported at
+``deepspeed/__init__.py``): ``zero.Init``, MiCS, memory-needs estimators,
+partition planners.
+"""
+
+from ..runtime.zero import *  # noqa: F401,F403
+from ..runtime.zero import __all__  # noqa: F401

@@ -36,17 +36,17 @@ class TestChunkedParity:
         q, k, v = _qkv()
         o_ref = attention_xla(q, k, v, **kw)
         o = attention_chunked(q, k, v, chunk=32, **kw)
-        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=3e-6)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=1e-5)
 
     def test_uneven_chunks(self):
         q, k, v = _qkv(s=100)  # 100 % 32 != 0: pad path
         np.testing.assert_allclose(np.asarray(attention_chunked(q, k, v, chunk=32)),
-                                   np.asarray(attention_xla(q, k, v)), atol=3e-6)
+                                   np.asarray(attention_xla(q, k, v)), atol=1e-5)
 
     def test_gqa(self):
         q, k, v = _qkv(h=8, kv_h=2)
         np.testing.assert_allclose(np.asarray(attention_chunked(q, k, v, chunk=16)),
-                                   np.asarray(attention_xla(q, k, v)), atol=3e-6)
+                                   np.asarray(attention_xla(q, k, v)), atol=1e-5)
 
     def test_decode_kv_len(self):
         k1, k2, k3 = jax.random.split(jax.random.PRNGKey(1), 3)
@@ -55,7 +55,7 @@ class TestChunkedParity:
         v = jax.random.normal(k3, (2, 128, 4, 16))
         o_ref = attention_xla(q, k, v, kv_len=90)
         o = attention_chunked(q, k, v, kv_len=90, chunk=32)
-        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=3e-6)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(o_ref), atol=1e-5)
 
     def test_gradients_match(self):
         q, k, v = _qkv(s=64)
@@ -70,7 +70,7 @@ class TestChunkedParity:
         bias = jax.random.normal(jax.random.PRNGKey(2), (1, 4, 64, 64))
         np.testing.assert_allclose(
             np.asarray(attention_chunked(q, k, v, bias=bias, chunk=16)),
-            np.asarray(attention_xla(q, k, v, bias=bias)), atol=3e-6)
+            np.asarray(attention_xla(q, k, v, bias=bias)), atol=1e-5)
         # broadcast bias + grads (dbias reduces over the broadcast batch dim)
         bb = jax.random.normal(jax.random.PRNGKey(3), (1, 1, 1, 64))
         g_ref = jax.grad(lambda b: attention_xla(q, k, v, bias=jnp.broadcast_to(b, (2, 4, 64, 64))).sum())(bb)

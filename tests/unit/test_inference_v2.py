@@ -138,6 +138,7 @@ class TestEngineV2:
         assert free == 63 and max_toks == 63 * 8
         assert eng.can_put(0, list(range(16)))
 
+    @pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
     def test_gpt2_style_model(self):
         cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=2, d_model=16, max_seq_len=64, norm="layernorm",
                                 activation="gelu", pos_emb="learned", tie_embeddings=True)
@@ -167,13 +168,11 @@ class TestEngineV2:
 
     def test_window_layers_rejected(self):
         """Mixed global/local stacks (gpt-neo) must be refused, not mis-served."""
-        import pytest as _pytest
-
         cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=2, d_model=16, max_seq_len=64, norm="layernorm",
                                 activation="gelu", pos_emb="learned", sliding_window=4, window_layers=(1,))
         model = CausalLM(cfg)
         params = model.init(jax.random.PRNGKey(3), {"input_ids": np.zeros((1, 8), np.int32)})
-        with _pytest.raises(NotImplementedError, match="window_layers"):
+        with pytest.raises(NotImplementedError, match="window_layers"):
             InferenceEngineV2(
                 model, params,
                 RaggedInferenceEngineConfig(state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64,
@@ -240,6 +239,7 @@ def _moe_model():
 
 class TestEngineV2MoE:
 
+    @pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
     def test_moe_generate_matches_dense(self):
         """Ragged MoE serving (ref v2 ragged_ops moe_scatter/top_k_gating)
         matches the dense training-path forward."""

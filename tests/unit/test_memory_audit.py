@@ -56,6 +56,7 @@ def test_llama7b_fits_v5e_budget(mesh8):
     assert a.allgather_count < 200, a.allgather_count
 
 
+@pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
 def test_llama7b_unrolled_is_pathological(mesh8):
     """Document WHY the defaults matter: the unrolled fp32 graph blows the
     budget (weight gathers hoisted + quadratic attention + no remat)."""

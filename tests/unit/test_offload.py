@@ -177,6 +177,7 @@ class TestEngineOffload:
         off2.load_checkpoint(str(tmp_path), tag="t1")
         np.testing.assert_allclose(float(off2.train_batch(iter([batches[1]]))), loss_next, rtol=1e-5)
 
+    @pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
     def test_offload_universal_checkpoint(self, mesh8, tmp_path):
         off = _make_engine("cpu")
         batches = _batches(2)

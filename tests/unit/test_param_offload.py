@@ -105,6 +105,7 @@ class TestTrajectory:
         for a, b_ in zip(jax.tree_util.tree_leaves(pr), jax.tree_util.tree_leaves(po)):
             np.testing.assert_allclose(a, b_, atol=1e-5, rtol=1e-5)
 
+    @pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
     def test_grad_accumulation_path(self, mesh8):
         eng = _make_engine("cpu", gas=2, fused=False)
         batches = _batches(4)
@@ -148,6 +149,7 @@ class TestTrajectory:
         on_disk = np.memmap(mm.filename, dtype=np.float32, mode="r", shape=mm.shape)
         np.testing.assert_array_equal(np.asarray(mm), np.asarray(on_disk))
 
+    @pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
     def test_checkpoint_roundtrip(self, mesh8, tmp_path):
         eng = _make_engine("cpu")
         batches = _batches(2)

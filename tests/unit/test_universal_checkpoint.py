@@ -83,6 +83,7 @@ def test_ds_to_universal_and_resume_across_topology(tmp_path):
     np.testing.assert_allclose(la, lb, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
 def test_save_universal_direct(tmp_path):
     uni = str(tmp_path / "uni")
     src = _make_engine(stage=2)

@@ -49,6 +49,7 @@ def test_zeropp_applicability():
     assert ok
 
 
+@pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
 def test_qwz_matches_baseline():
     base = _train(_engine())
     qwz = _train(_engine(zero_extra={"zero_quantized_weights": True}))
@@ -59,6 +60,7 @@ def test_qwz_matches_baseline():
     assert abs(qwz[-1] - base[-1]) < 0.5
 
 
+@pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
 def test_qgz_matches_baseline():
     base = _train(_engine())
     qgz = _train(_engine(zero_extra={"zero_quantized_gradients": True}))
@@ -67,6 +69,7 @@ def test_qgz_matches_baseline():
     assert abs(qgz[-1] - base[-1]) < 0.5
 
 
+@pytest.mark.nightly  # slow-parity tier: sibling tests keep this subsystem's oracle in the default run
 def test_hpz_exact_vs_baseline():
     # hpZ changes only WHERE the backward regather reads from — the math
     # is exact, so the trajectory must match the GSPMD baseline tightly
