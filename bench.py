@@ -93,8 +93,10 @@ def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
 
     model = CausalLM(cfg_model)
     params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, prompt_len), np.int32)})
-    eng = deepspeed_tpu.init_inference(model, config={"dtype": "bf16", "max_out_tokens": prompt_len + new_tokens},
-                                       params=params)
+    v1_cfg = {"dtype": "bf16", "max_out_tokens": prompt_len + new_tokens}
+    if os.environ.get("DS_BENCH_QUANT") == "1":  # int8 weight-only A/B
+        v1_cfg["quant"] = {"enabled": True, "bits": 8, "group_size": 128}
+    eng = deepspeed_tpu.init_inference(model, config=v1_cfg, params=params)
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, cfg_model.vocab_size, size=(batch, prompt_len)).astype(np.int32)
     half = max(1, new_tokens // 2)
@@ -130,7 +132,8 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
     # zero-fill pages the CPU smoke path never touches)
     smc = RaggedBatchConfig(max_context=max_ctx)
     smc.num_kv_blocks = n_prompts * (-(-max_ctx // smc.kv_block_size)) + 8
-    cfg = RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16")
+    cfg = RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16",
+                                      quant_bits=8 if os.environ.get("DS_BENCH_QUANT") == "1" else 0)
     eng = InferenceEngineV2(model, params, cfg)
     rng = np.random.RandomState(0)
     # varied prompt lengths: a ragged workload, not a lockstep batch
@@ -189,15 +192,35 @@ def run_attention_ab(jax, jnp, np, platform, iters=20):
     from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
 
     B, S, H, D = (8, 1024, 12, 64) if platform == "tpu" else (2, 256, 4, 16)
+    return _attention_ab(jax, jnp, (B, S, H, D), iters,
+                         {"xla": attention_xla, "chunked": attention_chunked,
+                          **({"flash": flash_attention} if platform == "tpu" else {})})
+
+
+def run_longctx_ab(jax, jnp, np, platform, iters=10):
+    """Long-context attention: S=8192 fwd+bwd, flash vs chunked only.
+
+    The materializing XLA path is excluded by design — its (B,H,S,S) fp32
+    logits are 3.2 GB at this shape; the long-context story is carried by
+    the O(S*block) paths (flash kernel; chunked online-softmax fallback).
+    """
+    from deepspeed_tpu.ops.attention import attention_chunked
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    shape = (1, 8192, 12, 64) if platform == "tpu" else (1, 512, 4, 16)
+    impls = {"chunked": attention_chunked}
+    if platform == "tpu":
+        impls["flash"] = flash_attention
+    return _attention_ab(jax, jnp, shape, iters, impls)
+
+
+def _attention_ab(jax, jnp, shape, iters, impls):
+    B, S, H, D = shape
     k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(k1, (B, S, H, D), jnp.bfloat16)
     k = jax.random.normal(k2, (B, S, H, D), jnp.bfloat16)
     v = jax.random.normal(k3, (B, S, H, D), jnp.bfloat16)
     flops = 4 * B * H * S * S * D * 2.5
-
-    impls = {"xla": attention_xla, "chunked": attention_chunked}
-    if platform == "tpu":
-        impls["flash"] = flash_attention
 
     out = {}
     for name, fn in impls.items():
@@ -242,13 +265,15 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             "unit": "tokens/s/chip",
             "vs_baseline": round(tps / baseline, 4),
         }
-    if rung == "attn":
-        tfs = run_attention_ab(jax, jnp, np, platform, iters=max(iters, 3))
+    if rung in ("attn", "longctx"):
+        ab = run_attention_ab if rung == "attn" else run_longctx_ab
+        tfs = ab(jax, jnp, np, platform, iters=max(iters, 3) if rung == "attn" else 10)
         if not tfs:
             raise RuntimeError("all attention impls failed")
         winner = max(tfs, key=tfs.get)
+        seq = ("_s8192" if platform == "tpu" else "_s512") if rung == "longctx" else ""
         return {
-            "metric": f"attention_fwd_bwd_tflops_per_sec{tag}",
+            "metric": f"attention_fwd_bwd_tflops_per_sec{seq}{tag}",
             "value": tfs[winner],
             "unit": "TF/s",
             "vs_baseline": round(tfs[winner] / 98.5, 4),  # 50% of v5e ~197 bf16 peak
@@ -283,7 +308,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
 
 def main():
     rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
-    known = ("zero2", "zero3", "decode", "serve", "attn")
+    known = ("zero2", "zero3", "decode", "serve", "attn", "longctx")
     if rung not in known:
         print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected {' | '.join(known)}", file=sys.stderr)
         return 1

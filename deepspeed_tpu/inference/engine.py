@@ -21,6 +21,23 @@ from ..utils.logging import log_dist, logger
 from .config import DeepSpeedInferenceConfig
 
 
+class _DequantizingModule:
+    """Proxy whose ``apply`` dequantizes a weight-only-quantized param tree
+    inside the traced graph, so the flax module only ever sees dense
+    weights while HBM-at-rest holds int8+scales."""
+
+    def __init__(self, module):
+        self._module = module
+
+    def __getattr__(self, name):
+        return getattr(self._module, name)
+
+    def apply(self, params, *args, **kwargs):
+        from .quantization import dequantize_tree
+
+        return self._module.apply(dequantize_tree(params), *args, **kwargs)
+
+
 class InferenceEngine:
     def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None, params=None, mesh=None, **kwargs):
         self._config = config if isinstance(config, DeepSpeedInferenceConfig) else \
@@ -51,6 +68,23 @@ class InferenceEngine:
                                             mesh=self.topology, tp_size=tp)
         cast = lambda x: x.astype(self.dtype) if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.device_put(jax.tree_util.tree_map(cast, params), self.param_shardings)
+
+        if self._config.quant.enabled:
+            # weight-only quantization (ref inference/quantization/layers.py):
+            # params live int8+scales in HBM (capacity ~halved at rest);
+            # each jitted step dequantizes inside the graph. The v2 ragged
+            # engine's quant_bits path additionally keeps int8 through the
+            # matmuls via the fused dequant-matmul kernel.
+            if tp > 1:
+                raise NotImplementedError("weight-only quant + tensor-parallel v1 serving is not wired; "
+                                          "serve quantized at tp=1 (or use the v2 engine)")
+            from .quantization import quantize_model_params
+
+            qc = self._config.quant
+            self.params, _ = quantize_model_params(
+                self.params, {"weight_quantization": {"post_init_quant": {
+                    "*": {"num_bits": qc.bits, "group_size": qc.group_size}}}})
+            self.module = _DequantizingModule(self.module)
 
         self._prefill_fn = None
         self._decode_fn = None

@@ -1,4 +1,5 @@
 from .quantization import (QuantizationContext, QuantizedParam, dequantize_param, dequantize_tree,
-                           quantize_model_params)
+                           quantize_for_serving, quantize_model_params)
 
-__all__ = ["QuantizedParam", "QuantizationContext", "quantize_model_params", "dequantize_tree", "dequantize_param"]
+__all__ = ["QuantizedParam", "QuantizationContext", "quantize_model_params", "dequantize_tree",
+           "dequantize_param", "quantize_for_serving"]

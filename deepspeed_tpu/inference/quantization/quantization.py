@@ -29,21 +29,28 @@ from ...utils.logging import logger
 @dataclass
 class QuantizedParam:
     """int8-coded parameter + group scales; a pytree node so it can live
-    inside the params tree and flow through jit/device_put."""
-    q: jnp.ndarray          # int8 codes, (groups, group_size)
-    scales: jnp.ndarray     # f32, (groups, 1)
+    inside the params tree and flow through jit/device_put.
+
+    ``layout``: "flat" = groups along the flattened weight (the reference
+    wrappers' layout, dequantized whole); "kgroups" = matmul-native
+    ``q (K, N)`` + ``scales (K/g, N)`` consumed by the fused
+    dequant-matmul kernel (``ops/pallas/quantized_matmul.py``) without
+    ever materializing the bf16 weight."""
+    q: jnp.ndarray          # int8 codes
+    scales: jnp.ndarray     # f32 group scales
     shape: Tuple[int, ...]  # original shape (static)
     dtype: Any              # original dtype (static)
     num_bits: int = 8
+    layout: str = "flat"
 
     def tree_flatten(self):
-        return (self.q, self.scales), (self.shape, self.dtype, self.num_bits)
+        return (self.q, self.scales), (self.shape, self.dtype, self.num_bits, self.layout)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         q, scales = children
-        shape, dtype, num_bits = aux
-        return cls(q=q, scales=scales, shape=shape, dtype=dtype, num_bits=num_bits)
+        shape, dtype, num_bits, layout = aux
+        return cls(q=q, scales=scales, shape=shape, dtype=dtype, num_bits=num_bits, layout=layout)
 
     @property
     def nbytes_quantized(self) -> int:
@@ -64,9 +71,64 @@ def quantize_param(w: jnp.ndarray, num_bits: int = 8, group_size: int = 64) -> Q
 
 
 def dequantize_param(qp: QuantizedParam) -> jnp.ndarray:
+    if qp.layout == "kgroups":
+        K, N = qp.q.shape
+        g = K // qp.scales.shape[0]
+        wf = qp.q.astype(jnp.float32).reshape(K // g, g, N) * qp.scales[:, None, :]
+        return wf.reshape(qp.shape).astype(qp.dtype)
     from ...ops.pallas.quantization import dequantize_groupwise_xla
 
     return dequantize_groupwise_xla(qp.q, qp.scales, out_shape=qp.shape, out_dtype=qp.dtype)
+
+
+def _matmul_2d_form(path_key: str, shape: Tuple[int, ...]) -> Optional[Tuple[int, int]]:
+    """(K, N) 2D matmul form of a model ``kernel`` leaf, or None to skip.
+
+    flax DenseGeneral stores kernels as (in_dims..., out_dims...): q/k/v
+    are (d, H, Dh) — contract the leading d; o_proj is (H, Dh, d) —
+    contract the leading (H, Dh); 2D Dense kernels contract dim 0.
+    """
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    if len(shape) == 3:
+        # explicit allowlist: an unknown 3D kernel gets NO quantization
+        # rather than a guessed (and possibly transposed) K/N split
+        if path_key == "o_proj":
+            return shape[0] * shape[1], shape[2]
+        if path_key in ("q_proj", "k_proj", "v_proj"):
+            return shape[0], shape[1] * shape[2]
+    return None
+
+
+def quantize_for_serving(params, num_bits: int = 8, group_size: int = 128, min_size: int = 4096):
+    """Quantize matmul ``kernel`` weights into the fused-kernel ("kgroups")
+    layout for the v2 serving engine: attention projections, MLP linears
+    and the untied lm_head. Embeddings (gather consumers), norms, biases
+    and MoE expert stacks stay dense.
+    """
+    from ...ops.pallas.quantized_matmul import quantize_weight_kgroups
+
+    n_q = [0]
+
+    def leaf(path, w):
+        keys = [str(getattr(p, "key", "")) for p in path]
+        if keys[-1] != "kernel" or "moe" in keys or "experts" in keys:
+            return w
+        if not hasattr(w, "shape") or w.size < min_size:
+            return w
+        form = _matmul_2d_form(keys[-2], tuple(w.shape))
+        if form is None:
+            return w
+        K, N = form
+        q, scales = quantize_weight_kgroups(jnp.asarray(w).reshape(K, N), group_size=group_size, bits=num_bits)
+        n_q[0] += 1
+        return QuantizedParam(q=q, scales=scales, shape=tuple(w.shape), dtype=jnp.asarray(w).dtype,
+                              num_bits=num_bits, layout="kgroups")
+
+    out = jax.tree_util.tree_map_with_path(leaf, params)
+    logger.info(f"quantize_for_serving: {n_q[0]} matmul weights -> int{num_bits} "
+                f"(kgroups, group_size={group_size})")
+    return out
 
 
 def quantize_model_params(params, ds_config: Optional[Dict] = None, min_size: int = 1024):

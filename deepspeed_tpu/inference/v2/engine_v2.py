@@ -45,6 +45,10 @@ class RaggedInferenceEngineConfig:
     dtype: str = "bfloat16"
     interpret_kernels: Optional[bool] = None  # Pallas interpret mode; default: on unless running on real TPU
     decode_burst: int = 32  # max fused greedy-decode steps per dispatch (0 disables bursting)
+    # weight-only quantization (ref inference/quantization + mixed-GEMM):
+    # matmul kernels stored int8-in-HBM, dequantized in-kernel per tile
+    quant_bits: int = 0  # 0 = off; 8 (or 4: int4 code range, int8 storage)
+    quant_group_size: int = 128
 
     @classmethod
     def from_dict(cls, d: Dict) -> "RaggedInferenceEngineConfig":
@@ -133,6 +137,14 @@ class InferenceEngineV2:
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.tree_util.tree_map(cast, params)
+        if config.quant_bits:
+            if self._tp > 1:
+                raise NotImplementedError("weight-only quant + tensor-parallel serving: quantize after "
+                                          "sharding is not wired yet — serve quantized at tp=1")
+            from ..quantization import quantize_for_serving
+
+            self.params = quantize_for_serving(self.params, num_bits=config.quant_bits,
+                                               group_size=config.quant_group_size)
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 

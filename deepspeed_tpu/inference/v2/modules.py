@@ -49,8 +49,32 @@ def _norm_p(cfg: TransformerConfig, container, idx: int):
     return container[f"{_norm_key(cfg)}_{idx}"]
 
 
+def _qproj(x, qp, dtype):
+    """Apply a kgroups-quantized kernel through the fused dequant-matmul
+    (ref mixed-GEMM): flatten x's trailing dims to the contraction size,
+    restore the kernel's output dims after."""
+    from ...ops.registry import REGISTRY as _R
+
+    K = qp.q.shape[0]
+    t, i = 1, x.ndim
+    while t < K:
+        i -= 1
+        t *= x.shape[i]
+    assert t == K, (x.shape, qp.q.shape)
+    t, j = 1, 0
+    while t < K:
+        t *= qp.shape[j]
+        j += 1
+    out2 = _R.get("quantized_matmul")(x.reshape(-1, K).astype(dtype), qp.q, qp.scales)
+    return out2.reshape(x.shape[:i] + tuple(qp.shape[j:])).astype(dtype)
+
+
 def _proj(x, p, spec, dtype):
-    y = jnp.einsum(spec, x, p["kernel"].astype(dtype))
+    w = p["kernel"]
+    if getattr(w, "layout", None) == "kgroups":  # QuantizedParam (weight-only serving quant)
+        y = _qproj(x, w, dtype)
+    else:
+        y = jnp.einsum(spec, x, w.astype(dtype))
     if "bias" in p:
         y = y + p["bias"].astype(dtype)
     return y
@@ -166,9 +190,7 @@ def unembed_tpu(cfg: TransformerConfig, params: Dict[str, Any], x, last_token_id
     if cfg.tie_embeddings:
         logits = jnp.einsum("bd,vd->bv", last, params["wte"].astype(cfg.dtype))
     else:
-        logits = jnp.einsum("bd,dv->bv", last, params["lm_head"]["kernel"].astype(cfg.dtype))
-        if "bias" in params.get("lm_head", {}):
-            logits = logits + params["lm_head"]["bias"].astype(cfg.dtype)
+        logits = _proj(last, params["lm_head"], "bd,dv->bv", cfg.dtype)
     return logits.astype(jnp.float32)
 
 

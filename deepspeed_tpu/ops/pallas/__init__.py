@@ -2,7 +2,7 @@
 higher priority than the XLA fallbacks; selection is per-op via
 availability probing (real TPU backend) or DS_TPU_OP_* env overrides."""
 
-from . import flash_attention, fused_adam, fused_lamb, norms, quantization  # noqa: F401
+from . import flash_attention, fused_adam, fused_lamb, norms, quantization, quantized_matmul  # noqa: F401
 
 from .flash_attention import flash_attention as flash_attention_fn
 from .fused_adam import fused_adam_flat

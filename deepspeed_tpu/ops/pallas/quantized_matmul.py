@@ -1,0 +1,139 @@
+"""Fused dequantize-matmul for weight-only-quantized serving.
+
+Capability parity: the reference's inference dequant + GEMM paths
+(``csrc/transformer/inference/csrc/dequantize.cu`` feeding the
+vector_matmul/qkv bindings in ``pt_binding.cpp``, and the cutlass
+mixed-GEMM in ``inference/v2/kernels/cutlass_ops/mixed_gemm``): the
+weight stays int8 in device memory and is dequantized in on-chip memory
+right before the MXU, so a decode step reads roughly half the HBM bytes
+of a bf16 weight.
+
+Quantization layout is *matmul-native* (different from the flat groupwise
+layout in ``ops/pallas/quantization.py``): for a weight reshaped to its
+2D matmul form ``(K, N)``, codes are int8 ``(K, N)`` and scales are fp32
+``(K/g, N)`` — symmetric per-(K-group, output-column) absmax scaling, so
+the kernel dequantizes one ``(g, bn)`` tile with one row of scales.
+
+``quantized_matmul(x, q, scales)``: x ``(M, K)`` float; returns ``(M, N)``
+fp32-accumulated, cast back to x.dtype. The registry dispatches the
+Pallas kernel on TPU for conforming shapes and the XLA fallback (which
+materializes the dequantized weight) otherwise.
+"""
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..registry import REGISTRY, pallas_available, register_op
+from ._utils import block_that_divides, compiler_params as _compiler_params
+
+# static unroll bound for the in-kernel contraction loop; beyond it the
+# dispatcher falls back to XLA rather than compile a huge program
+MAX_GROUPS = 64
+
+
+def quantize_weight_kgroups(w: jnp.ndarray, group_size: int = 128,
+                            bits: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize a 2D matmul weight ``(K, N)`` into K-grouped symmetric int8.
+
+    Returns ``(codes (K, N) int8, scales (K/g, N) f32)``. ``bits=4`` uses
+    the int4 code range in int8 storage (a precision knob; bit-packing is
+    the flat-layout kernels' province).
+    """
+    K, N = w.shape
+    g = group_size if K % group_size == 0 else block_that_divides(K, group_size)
+    wf = w.astype(jnp.float32).reshape(K // g, g, N)
+    absmax = jnp.max(jnp.abs(wf), axis=1)  # (K/g, N)
+    qmax = float(2**(bits - 1) - 1)
+    scales = jnp.where(absmax == 0, 1.0, absmax / qmax)
+    q = jnp.clip(jnp.round(wf / scales[:, None, :]), -qmax - 1, qmax).astype(jnp.int8)
+    return q.reshape(K, N), scales
+
+
+def quantized_matmul_xla(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray, **_) -> jnp.ndarray:
+    """Reference/fallback: dequantize then matmul (XLA materializes)."""
+    K, N = q.shape
+    g = K // scales.shape[0]
+    wf = q.astype(jnp.float32).reshape(K // g, g, N) * scales[:, None, :]
+    out = jax.lax.dot_general(x.astype(jnp.float32), wf.reshape(K, N),
+                              (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    return out.astype(x.dtype)
+
+
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bm: int, bn: int, g: int, n_groups: int):
+    x = x_ref[0]  # (bm, K)
+    acc = jnp.zeros((bm, bn), jnp.float32)
+    # static unroll: lane-dim slices at group-aligned offsets, one skinny
+    # MXU dot per group — dequant never leaves VMEM
+    for kg in range(n_groups):
+        wq = q_ref[0, pl.dslice(kg * g, g), :]            # (g, bn) int8
+        wf = wq.astype(jnp.float32) * s_ref[0, kg, :][None, :]
+        xk = x[:, kg * g:(kg + 1) * g].astype(jnp.float32)
+        acc = acc + jax.lax.dot_general(xk, wf, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+    o_ref[0] = acc.astype(o_ref.dtype)
+
+
+def quantized_matmul_pallas(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray, *,
+                            block_m: int = 256, block_n: int = 512,
+                            interpret: bool = False) -> jnp.ndarray:
+    """(M, K) @ dequant((K, N)) -> (M, N); int8 codes stay in HBM, each
+    program dequantizes (g, bn) tiles in VMEM inside the contraction."""
+    M, K = x.shape
+    Kw, N = q.shape
+    assert K == Kw, (x.shape, q.shape)
+    n_groups = scales.shape[0]
+    assert K % n_groups == 0, (K, n_groups)
+    g = K // n_groups
+
+    # pad M to a sublane multiple so every block is (8k, ...) aligned
+    Mp = -(-M // 8) * 8
+    xp = x if Mp == M else jnp.concatenate([x, jnp.zeros((Mp - M, K), x.dtype)], axis=0)
+    bm = block_that_divides(Mp, block_m)
+    bn = block_that_divides(N, block_n)
+
+    kernel = functools.partial(_qmm_kernel, bm=bm, bn=bn, g=g, n_groups=n_groups)
+    out = pl.pallas_call(
+        kernel,
+        grid=(Mp // bm, N // bn),
+        in_specs=[
+            pl.BlockSpec((1, bm, K), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((1, K, bn), lambda i, j: (0, 0, j)),
+            pl.BlockSpec((1, n_groups, bn), lambda i, j: (0, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, bm, bn), lambda i, j: (0, i, j)),
+        out_shape=jax.ShapeDtypeStruct((1, Mp, N), x.dtype),
+        interpret=interpret,
+        compiler_params=_compiler_params("parallel", "parallel", interpret=interpret),
+    )(xp[None], q[None], scales[None])[0]
+    return out if Mp == M else out[:M]
+
+
+def _conforming(x, q, scales) -> bool:
+    """Shapes the Pallas path handles under the (8, 128) tiling rules; the
+    XLA fallback takes the rest (odd lane dims, giant group counts)."""
+    K, N = q.shape
+    n_groups = scales.shape[0]
+    g = K // n_groups
+    return (n_groups <= MAX_GROUPS and g % 128 == 0 and (N % 128 == 0 or N < 128)
+            and K % 128 == 0)
+
+
+@register_op("quantized_matmul", "xla", priority=0)
+def _qmm_xla(x, q, scales, **kw):
+    return quantized_matmul_xla(x, q, scales, **kw)
+
+
+@register_op("quantized_matmul", "pallas", is_available=pallas_available, priority=10)
+def _qmm_pallas(x, q, scales, **kw):
+    if not _conforming(x, q, scales):
+        return quantized_matmul_xla(x, q, scales, **kw)
+    return quantized_matmul_pallas(x, q, scales, **kw)
+
+
+def quantized_matmul(x, q, scales, **kw):
+    """Registry-dispatched entry (Pallas on TPU, XLA elsewhere)."""
+    return REGISTRY.get("quantized_matmul")(x, q, scales, **kw)

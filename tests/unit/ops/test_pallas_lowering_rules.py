@@ -171,6 +171,17 @@ def test_quantization_specs(record):
     dequantize_groupwise(qv, scales, out_shape=x.shape, interpret=True)
 
 
+def test_quantized_matmul_specs(record):
+    from deepspeed_tpu.ops.pallas.quantized_matmul import quantize_weight_kgroups, quantized_matmul_pallas
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (256, 384), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, 256), jnp.bfloat16)
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    quantized_matmul_pallas(x, q, s, interpret=True)
+    # decode-shaped tiny M goes through the sublane pad path
+    quantized_matmul_pallas(x[:2], q, s, interpret=True)
+
+
 def test_sparse_attention_specs(record):
     from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig, sparse_attention
 

@@ -1,0 +1,83 @@
+"""Fused dequant-matmul kernel (weight-only serving quantization).
+
+Oracle laddering: quantize_weight_kgroups -> (a) XLA dequant+matmul and
+(b) Pallas kernel in interpret mode must agree bit-tight (same fp32
+contraction math); the quantization itself is accuracy-bounded vs the
+dense weight.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.pallas.quantized_matmul import (quantize_weight_kgroups, quantized_matmul_pallas,
+                                                       quantized_matmul_xla)
+
+pytestmark = pytest.mark.fast
+
+
+def _wx(K=256, N=384, M=16, seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    w = jax.random.normal(k1, (K, N), jnp.float32) * 0.05
+    x = jax.random.normal(k2, (M, K), jnp.float32)
+    return w, x
+
+
+def test_quantize_roundtrip_accuracy():
+    w, _ = _wx()
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    K, N = w.shape
+    g = K // s.shape[0]
+    wf = q.astype(jnp.float32).reshape(K // g, g, N) * s[:, None, :]
+    err = float(jnp.max(jnp.abs(wf.reshape(K, N) - w)))
+    # symmetric int8: err <= absmax/127 per group
+    assert err <= float(jnp.max(jnp.abs(w))) / 127 + 1e-7
+
+
+def test_pallas_matches_xla_fp32():
+    w, x = _wx()
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    ref = quantized_matmul_xla(x, q, s)
+    got = quantized_matmul_pallas(x, q, s, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_bf16_io():
+    w, x = _wx(K=384, N=256, M=8)
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    got = quantized_matmul_pallas(x.astype(jnp.bfloat16), q, s, interpret=True)
+    ref = quantized_matmul_xla(x.astype(jnp.bfloat16), q, s)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2)
+
+
+def test_tiny_m_padding():
+    """Decode-shaped M < 8 goes through the sublane pad path."""
+    w, x = _wx(M=3)
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    got = quantized_matmul_pallas(x, q, s, interpret=True)
+    ref = quantized_matmul_xla(x, q, s)
+    assert got.shape == (3, 384)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_odd_group_size_falls_back():
+    """K not a multiple of group_size degrades the group (still correct)."""
+    w, x = _wx(K=320, N=128)  # 320 % 128 != 0 -> g drops to 64
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    assert 320 % s.shape[0] == 0
+    ref = quantized_matmul_xla(x, q, s)
+    dq_ref = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))
+    # quantization error only (layout correct): bounded, not tight
+    assert float(jnp.max(jnp.abs(ref - dq_ref))) < 0.5
+
+
+def test_against_dense_accuracy():
+    """End math: quantized matmul close to dense matmul (int8 error scale)."""
+    w, x = _wx()
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    got = quantized_matmul_pallas(x, q, s, interpret=True)
+    dense = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))
+    rel = float(jnp.max(jnp.abs(got - dense)) / jnp.max(jnp.abs(dense)))
+    assert rel < 0.02, rel

@@ -138,8 +138,23 @@ def _ops():
             for t, tok in enumerate(o):
                 assert tok == int(greedy[len(p) - 1 + t]), (p, t, tok, int(greedy[len(p) - 1 + t]))
 
+    def qmm():
+        # fused dequant-matmul vs its XLA oracle on the real Mosaic lowering
+        from deepspeed_tpu.ops.pallas.quantized_matmul import (quantize_weight_kgroups,
+                                                               quantized_matmul_pallas,
+                                                               quantized_matmul_xla)
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (768, 1024), jnp.float32) * 0.05
+        q, s = quantize_weight_kgroups(w, group_size=128)
+        for m in (3, 32, 256):  # decode pad path, decode batch, prefill tile
+            x = jax.random.normal(jax.random.PRNGKey(m), (m, 768), jnp.bfloat16)
+            got = jax.jit(quantized_matmul_pallas)(x, q, s)
+            ref = quantized_matmul_xla(x, q, s)
+            err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
+            assert err < 0.25, (m, err)
+
     return {"flash": flash, "sparse": sparse, "paged": paged, "norms": norms,
-            "optimizers": optimizers, "quant": quant, "serve": serve}
+            "optimizers": optimizers, "quant": quant, "qmm": qmm, "serve": serve}
 
 
 def main():
