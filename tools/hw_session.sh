@@ -1,0 +1,34 @@
+#!/bin/bash
+# One-shot TPU hardware session: run everything worth measuring in
+# sequence, tolerating individual failures, with incremental artifacts.
+# Protocol (PERF_NOTES.md): health-check first, one long-lived process
+# per step, never SIGKILL mid-compile.
+cd "$(dirname "$0")/.." || exit 1
+LOG=${1:-hw_session.log}
+: > "$LOG"
+
+note() { echo "[hw_session $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+note "health check (tiny matmul, 110s budget)"
+if ! timeout 110 python -c "
+import jax, jax.numpy as jnp
+print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$LOG" 2>&1; then
+    note "tunnel DEAD - aborting session"
+    exit 1
+fi
+
+note "1/3 hw_smoke (every Pallas kernel incl. quantized_matmul, on-chip parity)"
+timeout 1800 python tools/hw_smoke.py >> "$LOG" 2>&1
+note "hw_smoke rc=$?"
+
+note "2/3 bench.py full ladder (zero2 + zero3/decode/serve/attn/longctx extras -> BENCH_extra.json)"
+timeout 3600 python bench.py >> "$LOG" 2>&1
+note "bench rc=$?"
+
+note "3/3 int8 weight-only A/B (decode + serve rungs)"
+DS_BENCH_QUANT=1 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
+note "quant decode rc=$?"
+DS_BENCH_QUANT=1 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
+note "quant serve rc=$?"
+
+note "session complete - artifacts: BENCH_extra.json + $LOG"
