@@ -71,10 +71,10 @@ def quantize_param(w: jnp.ndarray, num_bits: int = 8, group_size: int = 64) -> Q
 
 
 def dequantize_param(qp: QuantizedParam) -> jnp.ndarray:
-    if qp.layout == "kgroups":
-        K, N = qp.q.shape
-        g = K // qp.scales.shape[0]
-        wf = qp.q.astype(jnp.float32).reshape(K // g, g, N) * qp.scales[:, None, :]
+    if qp.layout.startswith("kgroups"):
+        from ...ops.pallas.quantized_matmul import _dequantize_kgroups
+
+        wf = _dequantize_kgroups(qp.q, qp.scales, packed=qp.layout == "kgroups_p4")
         return wf.reshape(qp.shape).astype(qp.dtype)
     from ...ops.pallas.quantization import dequantize_groupwise_xla
 
@@ -120,10 +120,17 @@ def quantize_for_serving(params, num_bits: int = 8, group_size: int = 128, min_s
         if form is None:
             return w
         K, N = form
-        q, scales = quantize_weight_kgroups(jnp.asarray(w).reshape(K, N), group_size=group_size, bits=num_bits)
+        # true int4 storage (two codes per byte) needs an even group size;
+        # odd-g weights (odd K below group_size) keep int8 storage
+        from ...ops.pallas._utils import block_that_divides
+
+        g_eff = group_size if K % group_size == 0 else block_that_divides(K, group_size)
+        pack = num_bits == 4 and g_eff % 2 == 0
+        q, scales = quantize_weight_kgroups(jnp.asarray(w).reshape(K, N), group_size=group_size,
+                                            bits=num_bits, pack=pack)
         n_q[0] += 1
         return QuantizedParam(q=q, scales=scales, shape=tuple(w.shape), dtype=jnp.asarray(w).dtype,
-                              num_bits=num_bits, layout="kgroups")
+                              num_bits=num_bits, layout="kgroups_p4" if pack else "kgroups")
 
     out = jax.tree_util.tree_map_with_path(leaf, params)
     logger.info(f"quantize_for_serving: {n_q[0]} matmul weights -> int{num_bits} "

@@ -47,8 +47,9 @@ class RaggedInferenceEngineConfig:
     decode_burst: int = 32  # max fused greedy-decode steps per dispatch (0 disables bursting)
     # weight-only quantization (ref inference/quantization + mixed-GEMM):
     # matmul kernels stored int8-in-HBM, dequantized in-kernel per tile
-    quant_bits: int = 0  # 0 = off; 8 (or 4: int4 code range, int8 storage)
+    quant_bits: int = 0  # 0 = off; 8, or 4 (TRUE packed int4 storage, 2 codes/byte)
     quant_group_size: int = 128
+    quant_min_size: int = 4096  # leave smaller weights dense
 
     @classmethod
     def from_dict(cls, d: Dict) -> "RaggedInferenceEngineConfig":
@@ -144,7 +145,8 @@ class InferenceEngineV2:
             from ..quantization import quantize_for_serving
 
             self.params = quantize_for_serving(self.params, num_bits=config.quant_bits,
-                                               group_size=config.quant_group_size)
+                                               group_size=config.quant_group_size,
+                                               min_size=config.quant_min_size)
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 

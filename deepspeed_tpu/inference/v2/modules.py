@@ -55,7 +55,8 @@ def _qproj(x, qp, dtype):
     restore the kernel's output dims after."""
     from ...ops.registry import REGISTRY as _R
 
-    K = qp.q.shape[0]
+    packed = qp.layout == "kgroups_p4"
+    K = qp.q.shape[0] * (2 if packed else 1)
     t, i = 1, x.ndim
     while t < K:
         i -= 1
@@ -65,13 +66,13 @@ def _qproj(x, qp, dtype):
     while t < K:
         t *= qp.shape[j]
         j += 1
-    out2 = _R.get("quantized_matmul")(x.reshape(-1, K).astype(dtype), qp.q, qp.scales)
+    out2 = _R.get("quantized_matmul")(x.reshape(-1, K).astype(dtype), qp.q, qp.scales, packed=packed)
     return out2.reshape(x.shape[:i] + tuple(qp.shape[j:])).astype(dtype)
 
 
 def _proj(x, p, spec, dtype):
     w = p["kernel"]
-    if getattr(w, "layout", None) == "kgroups":  # QuantizedParam (weight-only serving quant)
+    if str(getattr(w, "layout", "")).startswith("kgroups"):  # QuantizedParam (weight-only serving quant)
         y = _qproj(x, w, dtype)
     else:
         y = jnp.einsum(spec, x, w.astype(dtype))

@@ -35,13 +35,18 @@ from ._utils import block_that_divides, compiler_params as _compiler_params
 MAX_GROUPS = 64
 
 
-def quantize_weight_kgroups(w: jnp.ndarray, group_size: int = 128,
-                            bits: int = 8) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Quantize a 2D matmul weight ``(K, N)`` into K-grouped symmetric int8.
+def quantize_weight_kgroups(w: jnp.ndarray, group_size: int = 128, bits: int = 8,
+                            pack: bool = False) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize a 2D matmul weight ``(K, N)`` into K-grouped symmetric codes.
 
-    Returns ``(codes (K, N) int8, scales (K/g, N) f32)``. ``bits=4`` uses
-    the int4 code range in int8 storage (a precision knob; bit-packing is
-    the flat-layout kernels' province).
+    Returns ``(codes, scales (K/g, N) f32)``. ``bits=8``: codes int8
+    ``(K, N)``. ``bits=4, pack=True``: codes int8 ``(K/2, N)`` — TWO int4
+    nibbles per byte (the reference's true-int4 storage). Packing layout:
+    within each group, byte row ``r`` holds code ``k = r`` in the LOW
+    nibble and ``k = r + g/2`` in the HIGH nibble, so the kernel's unpack
+    is a sublane concat (Mosaic-friendly), not an interleave.
+    ``bits=4, pack=False`` keeps int4 code range in int8 storage (a
+    precision-only knob).
     """
     K, N = w.shape
     g = group_size if K % group_size == 0 else block_that_divides(K, group_size)
@@ -49,28 +54,62 @@ def quantize_weight_kgroups(w: jnp.ndarray, group_size: int = 128,
     absmax = jnp.max(jnp.abs(wf), axis=1)  # (K/g, N)
     qmax = float(2**(bits - 1) - 1)
     scales = jnp.where(absmax == 0, 1.0, absmax / qmax)
-    q = jnp.clip(jnp.round(wf / scales[:, None, :]), -qmax - 1, qmax).astype(jnp.int8)
-    return q.reshape(K, N), scales
+    q = jnp.clip(jnp.round(wf / scales[:, None, :]), -qmax - 1, qmax).astype(jnp.int32)
+    if not pack:
+        return q.reshape(K, N).astype(jnp.int8), scales
+    assert bits == 4, "packing is the int4 storage format"
+    assert g % 2 == 0, g
+    lo = q[:, :g // 2, :] & 15          # low nibble: rows [0, g/2)
+    hi = q[:, g // 2:, :] & 15          # high nibble: rows [g/2, g)
+    packed = (lo | (hi << 4)).astype(jnp.int8)  # (K/g, g/2, N)
+    return packed.reshape(K // 2, N), scales
 
 
-def quantized_matmul_xla(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray, **_) -> jnp.ndarray:
+def _unpack_int4(p32, axis: int = 0):
+    """Packed int32 bytes -> signed codes, doubling ``axis`` (the per-group
+    row dim) via concat per the packing layout above — the ONE definition
+    of the nibble decode for both the kernel and the XLA dequant path."""
+    lo = ((p32 & 15) ^ 8) - 8
+    hi = (((p32 >> 4) & 15) ^ 8) - 8
+    return jnp.concatenate([lo, hi], axis=axis)
+
+
+def _dequantize_kgroups(q: jnp.ndarray, scales: jnp.ndarray, packed: bool) -> jnp.ndarray:
+    """Full (K, N) fp32 weight from kgroups codes (the XLA/materializing path)."""
+    n_groups = scales.shape[0]
+    if packed:
+        Kh, N = q.shape
+        gh = Kh // n_groups  # g/2 packed rows per group
+        p32 = q.astype(jnp.int32).reshape(n_groups, gh, N)
+        codes = _unpack_int4(p32, axis=1)  # (K/g, g, N)
+    else:
+        K, N = q.shape
+        codes = q.astype(jnp.int32).reshape(n_groups, K // n_groups, N)
+    return (codes.astype(jnp.float32) * scales[:, None, :]).reshape(-1, q.shape[1])
+
+
+def quantized_matmul_xla(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray, *,
+                         packed: bool = False, **_) -> jnp.ndarray:
     """Reference/fallback: dequantize then matmul (XLA materializes)."""
-    K, N = q.shape
-    g = K // scales.shape[0]
-    wf = q.astype(jnp.float32).reshape(K // g, g, N) * scales[:, None, :]
-    out = jax.lax.dot_general(x.astype(jnp.float32), wf.reshape(K, N),
+    wf = _dequantize_kgroups(q, scales, packed)
+    out = jax.lax.dot_general(x.astype(jnp.float32), wf,
                               (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
     return out.astype(x.dtype)
 
 
-def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bm: int, bn: int, g: int, n_groups: int):
+def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bm: int, bn: int, g: int, n_groups: int, packed: bool):
     x = x_ref[0]  # (bm, K)
     acc = jnp.zeros((bm, bn), jnp.float32)
+    gh = g // 2  # packed rows per group
     # static unroll: lane-dim slices at group-aligned offsets, one skinny
     # MXU dot per group — dequant never leaves VMEM
     for kg in range(n_groups):
-        wq = q_ref[0, pl.dslice(kg * g, g), :]            # (g, bn) int8
-        wf = wq.astype(jnp.float32) * s_ref[0, kg, :][None, :]
+        if packed:
+            p32 = q_ref[0, pl.dslice(kg * gh, gh), :].astype(jnp.int32)  # (g/2, bn) bytes
+            codes = _unpack_int4(p32)                                    # (g, bn)
+        else:
+            codes = q_ref[0, pl.dslice(kg * g, g), :].astype(jnp.int32)  # (g, bn) int8
+        wf = codes.astype(jnp.float32) * s_ref[0, kg, :][None, :]
         xk = x[:, kg * g:(kg + 1) * g].astype(jnp.float32)
         acc = acc + jax.lax.dot_general(xk, wf, (((1,), (0,)), ((), ())),
                                         preferred_element_type=jnp.float32)
@@ -78,13 +117,14 @@ def _qmm_kernel(x_ref, q_ref, s_ref, o_ref, *, bm: int, bn: int, g: int, n_group
 
 
 def quantized_matmul_pallas(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray, *,
-                            block_m: int = 256, block_n: int = 512,
+                            packed: bool = False, block_m: int = 256, block_n: int = 512,
                             interpret: bool = False) -> jnp.ndarray:
-    """(M, K) @ dequant((K, N)) -> (M, N); int8 codes stay in HBM, each
-    program dequantizes (g, bn) tiles in VMEM inside the contraction."""
+    """(M, K) @ dequant(codes) -> (M, N); int8 (or packed-int4) codes stay
+    in HBM, each program dequantizes (g, bn) tiles in VMEM inside the
+    contraction."""
     M, K = x.shape
-    Kw, N = q.shape
-    assert K == Kw, (x.shape, q.shape)
+    Kq, N = q.shape
+    assert K == Kq * (2 if packed else 1), (x.shape, q.shape, packed)
     n_groups = scales.shape[0]
     assert K % n_groups == 0, (K, n_groups)
     g = K // n_groups
@@ -95,13 +135,13 @@ def quantized_matmul_pallas(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray,
     bm = block_that_divides(Mp, block_m)
     bn = block_that_divides(N, block_n)
 
-    kernel = functools.partial(_qmm_kernel, bm=bm, bn=bn, g=g, n_groups=n_groups)
+    kernel = functools.partial(_qmm_kernel, bm=bm, bn=bn, g=g, n_groups=n_groups, packed=packed)
     out = pl.pallas_call(
         kernel,
         grid=(Mp // bm, N // bn),
         in_specs=[
             pl.BlockSpec((1, bm, K), lambda i, j: (0, i, 0)),
-            pl.BlockSpec((1, K, bn), lambda i, j: (0, 0, j)),
+            pl.BlockSpec((1, Kq, bn), lambda i, j: (0, 0, j)),
             pl.BlockSpec((1, n_groups, bn), lambda i, j: (0, 0, j)),
         ],
         out_specs=pl.BlockSpec((1, bm, bn), lambda i, j: (0, i, j)),
@@ -112,10 +152,11 @@ def quantized_matmul_pallas(x: jnp.ndarray, q: jnp.ndarray, scales: jnp.ndarray,
     return out if Mp == M else out[:M]
 
 
-def _conforming(x, q, scales) -> bool:
+def _conforming(x, q, scales, packed: bool) -> bool:
     """Shapes the Pallas path handles under the (8, 128) tiling rules; the
     XLA fallback takes the rest (odd lane dims, giant group counts)."""
-    K, N = q.shape
+    Kq, N = q.shape
+    K = Kq * (2 if packed else 1)
     n_groups = scales.shape[0]
     g = K // n_groups
     return (n_groups <= MAX_GROUPS and g % 128 == 0 and (N % 128 == 0 or N < 128)
@@ -128,10 +169,10 @@ def _qmm_xla(x, q, scales, **kw):
 
 
 @register_op("quantized_matmul", "pallas", is_available=pallas_available, priority=10)
-def _qmm_pallas(x, q, scales, **kw):
-    if not _conforming(x, q, scales):
-        return quantized_matmul_xla(x, q, scales, **kw)
-    return quantized_matmul_pallas(x, q, scales, **kw)
+def _qmm_pallas(x, q, scales, packed: bool = False, **kw):
+    if not _conforming(x, q, scales, packed):
+        return quantized_matmul_xla(x, q, scales, packed=packed, **kw)
+    return quantized_matmul_pallas(x, q, scales, packed=packed, **kw)
 
 
 def quantized_matmul(x, q, scales, **kw):

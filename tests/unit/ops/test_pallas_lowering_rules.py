@@ -180,6 +180,8 @@ def test_quantized_matmul_specs(record):
     quantized_matmul_pallas(x, q, s, interpret=True)
     # decode-shaped tiny M goes through the sublane pad path
     quantized_matmul_pallas(x[:2], q, s, interpret=True)
+    q4, s4 = quantize_weight_kgroups(w, group_size=128, bits=4, pack=True)
+    quantized_matmul_pallas(x, q4, s4, packed=True, interpret=True)
 
 
 def test_sparse_attention_specs(record):

@@ -81,3 +81,32 @@ def test_against_dense_accuracy():
     dense = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))
     rel = float(jnp.max(jnp.abs(got - dense)) / jnp.max(jnp.abs(dense)))
     assert rel < 0.02, rel
+
+
+def test_int4_pack_roundtrip():
+    """Packed storage halves bytes; unpack reproduces the unpacked codes."""
+    w, _ = _wx()
+    q8, s8 = quantize_weight_kgroups(w, group_size=128, bits=4, pack=False)
+    qp, sp = quantize_weight_kgroups(w, group_size=128, bits=4, pack=True)
+    assert qp.shape[0] == w.shape[0] // 2
+    np.testing.assert_allclose(np.asarray(sp), np.asarray(s8))
+    from deepspeed_tpu.ops.pallas.quantized_matmul import _dequantize_kgroups
+    np.testing.assert_allclose(np.asarray(_dequantize_kgroups(qp, sp, packed=True)),
+                               np.asarray(_dequantize_kgroups(q8, s8, packed=False)))
+
+
+def test_int4_packed_pallas_matches_xla():
+    w, x = _wx()
+    q, s = quantize_weight_kgroups(w, group_size=128, bits=4, pack=True)
+    ref = quantized_matmul_xla(x, q, s, packed=True)
+    got = quantized_matmul_pallas(x, q, s, packed=True, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_int4_against_dense_accuracy():
+    w, x = _wx()
+    q, s = quantize_weight_kgroups(w, group_size=128, bits=4, pack=True)
+    got = quantized_matmul_pallas(x, q, s, packed=True, interpret=True)
+    dense = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))
+    rel = float(jnp.max(jnp.abs(got - dense)) / jnp.max(jnp.abs(dense)))
+    assert rel < 0.2, rel  # int4: ~16 levels per group

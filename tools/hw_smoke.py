@@ -144,14 +144,16 @@ def _ops():
                                                                quantized_matmul_pallas,
                                                                quantized_matmul_xla)
 
+        import functools as _ft
         w = jax.random.normal(jax.random.PRNGKey(0), (768, 1024), jnp.float32) * 0.05
-        q, s = quantize_weight_kgroups(w, group_size=128)
-        for m in (3, 32, 256):  # decode pad path, decode batch, prefill tile
-            x = jax.random.normal(jax.random.PRNGKey(m), (m, 768), jnp.bfloat16)
-            got = jax.jit(quantized_matmul_pallas)(x, q, s)
-            ref = quantized_matmul_xla(x, q, s)
-            err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
-            assert err < 0.25, (m, err)
+        for bits, pack in ((8, False), (4, True)):  # int8 and packed-int4 storage
+            q, s = quantize_weight_kgroups(w, group_size=128, bits=bits, pack=pack)
+            for m in (3, 32, 256):  # decode pad path, decode batch, prefill tile
+                x = jax.random.normal(jax.random.PRNGKey(m), (m, 768), jnp.bfloat16)
+                got = jax.jit(_ft.partial(quantized_matmul_pallas, packed=pack))(x, q, s)
+                ref = quantized_matmul_xla(x, q, s, packed=pack)
+                err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
+                assert err < 0.25, (bits, m, err)
 
     return {"flash": flash, "sparse": sparse, "paged": paged, "norms": norms,
             "optimizers": optimizers, "quant": quant, "qmm": qmm, "serve": serve}
