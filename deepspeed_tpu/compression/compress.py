@@ -37,10 +37,9 @@ _PRUNE_TECHNIQUES = (SPARSE_PRUNING, ROW_PRUNING, HEAD_PRUNING, CHANNEL_PRUNING)
 
 
 def _path_str(path: Tuple) -> str:
-    parts = []
-    for p in path:
-        parts.append(str(getattr(p, "key", getattr(p, "idx", p))))
-    return "/".join(parts)
+    from ..utils.pytree import path_str
+
+    return path_str(path)
 
 
 def _match(path: str, patterns: List[str]) -> bool:

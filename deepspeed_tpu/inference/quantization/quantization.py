@@ -54,12 +54,15 @@ class QuantizedParam:
 
     @property
     def nbytes_quantized(self) -> int:
-        bits = self.num_bits
-        return (int(jnp.size(self.q)) * bits) // 8 + int(jnp.size(self.scales)) * 4
+        """ACTUAL storage bytes: codes are int8 storage in every layout
+        (kgroups_p4 already packs two int4 codes per stored byte)."""
+        return int(jnp.size(self.q)) + int(jnp.size(self.scales)) * 4
 
 
 def _path_str(path) -> str:
-    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
+    from ...utils.pytree import path_str
+
+    return path_str(path)
 
 
 def quantize_param(w: jnp.ndarray, num_bits: int = 8, group_size: int = 64) -> QuantizedParam:
@@ -120,14 +123,9 @@ def quantize_for_serving(params, num_bits: int = 8, group_size: int = 128, min_s
         if form is None:
             return w
         K, N = form
-        # true int4 storage (two codes per byte) needs an even group size;
-        # odd-g weights (odd K below group_size) keep int8 storage
-        from ...ops.pallas._utils import block_that_divides
-
-        g_eff = group_size if K % group_size == 0 else block_that_divides(K, group_size)
-        pack = num_bits == 4 and g_eff % 2 == 0
         q, scales = quantize_weight_kgroups(jnp.asarray(w).reshape(K, N), group_size=group_size,
-                                            bits=num_bits, pack=pack)
+                                            bits=num_bits, pack=num_bits == 4)
+        pack = q.shape[0] != K  # the quantizer degrades to unpacked when the group size is odd
         n_q[0] += 1
         return QuantizedParam(q=q, scales=scales, shape=tuple(w.shape), dtype=jnp.asarray(w).dtype,
                               num_bits=num_bits, layout="kgroups_p4" if pack else "kgroups")

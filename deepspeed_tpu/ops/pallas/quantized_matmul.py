@@ -46,7 +46,9 @@ def quantize_weight_kgroups(w: jnp.ndarray, group_size: int = 128, bits: int = 8
     nibble and ``k = r + g/2`` in the HIGH nibble, so the kernel's unpack
     is a sublane concat (Mosaic-friendly), not an interleave.
     ``bits=4, pack=False`` keeps int4 code range in int8 storage (a
-    precision-only knob).
+    precision-only knob). ``pack=True`` silently degrades to unpacked
+    int8 storage when the effective group size is odd — callers detect
+    packing from ``codes.shape[0] != K``.
     """
     K, N = w.shape
     g = group_size if K % group_size == 0 else block_that_divides(K, group_size)
@@ -55,10 +57,9 @@ def quantize_weight_kgroups(w: jnp.ndarray, group_size: int = 128, bits: int = 8
     qmax = float(2**(bits - 1) - 1)
     scales = jnp.where(absmax == 0, 1.0, absmax / qmax)
     q = jnp.clip(jnp.round(wf / scales[:, None, :]), -qmax - 1, qmax).astype(jnp.int32)
-    if not pack:
+    if not pack or g % 2 != 0:  # odd group size cannot split into nibble halves
         return q.reshape(K, N).astype(jnp.int8), scales
     assert bits == 4, "packing is the int4 storage format"
-    assert g % 2 == 0, g
     lo = q[:, :g // 2, :] & 15          # low nibble: rows [0, g/2)
     hi = q[:, g // 2:, :] & 15          # high nibble: rows [g/2, g)
     packed = (lo | (hi << 4)).astype(jnp.int8)  # (K/g, g/2, N)

@@ -775,6 +775,36 @@ class DeepSpeedEngine:
                                "but global_steps/scheduler state are not; call step() first (resuming a "
                                "checkpoint taken here would double-apply the update)")
 
+    def save_16bit_model(self, save_dir: str, save_filename: str = "model.safetensors"):
+        """Consolidated half-precision model export (reference
+        ``engine.py:3547`` ``save_16bit_model`` / ``:3478``
+        ``_zero3_consolidated_16bit_state_dict``): gathers every shard
+        (ZeRO-3 included — ``np.asarray`` on a sharded array is the
+        allgather) and writes ONE safetensors file of bf16 weights with
+        ``/``-joined native param paths. The HF-interop converters invert
+        per-arch naming; this export is the serve-anywhere artifact."""
+        import torch as _torch
+        from safetensors.torch import save_file as _save_file
+
+        from ..utils.pytree import path_str
+        from .checkpoint_engine import _to_host
+
+        self._check_no_pending_fused("save_16bit_model")
+        # every process participates in the gather (non-addressable ZeRO-3
+        # shards allgather across hosts); only process 0 writes the file
+        host_tree = _to_host(self.params)
+        out = os.path.join(save_dir, save_filename)
+        if jax.process_index() == 0:
+            flat = {}
+            for path, leaf in jax.tree_util.tree_leaves_with_path(host_tree):
+                t = _torch.from_numpy(np.asarray(leaf, dtype=np.float32))
+                flat[path_str(path)] = t.to(_torch.bfloat16).contiguous()
+            os.makedirs(save_dir, exist_ok=True)
+            _save_file(flat, out)
+            log_dist(f"save_16bit_model: {len(flat)} tensors -> {out}", ranks=[0])
+        dist.barrier(log_name="save_16bit_model")
+        return out
+
     def save_checkpoint(self, save_dir: str, tag=None, client_state: Optional[Dict] = None, save_latest: bool = True,
                         exclude_frozen_parameters: bool = False):
         self._check_no_pending_fused("save_checkpoint")

@@ -1,0 +1,12 @@
+"""Shared pytree helpers."""
+
+
+def path_str(path) -> str:
+    """Canonical "/"-joined string for a jax tree path.
+
+    THE single definition (previously copied in quantization, compression
+    and the engine's 16-bit export): these strings are load-bearing — the
+    compression config patterns and the ``save_16bit_model`` safetensors
+    keys both match against them.
+    """
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p))) for p in path)
