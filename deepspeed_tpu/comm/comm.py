@@ -117,6 +117,38 @@ def barrier(group=None, log_name: str = "barrier"):
         (jnp.zeros(()) + 0).block_until_ready()
 
 
+_monitored_barrier_seq = [0]
+
+
+def monitored_barrier(group=None, timeout: Optional[float] = None, wait_all_ranks: bool = False,
+                      log_name: str = "monitored_barrier"):
+    """Barrier that RAISES when peers fail to arrive within ``timeout``
+    seconds (reference ``comm.py:412`` — the gloo hang-detection barrier).
+
+    Multi-process: a HOST-level barrier on jax's distributed coordination
+    service (``wait_at_barrier`` has native timeout support), on the main
+    thread — device collectives are never involved, so a timeout leaves no
+    collective in flight (cf. ``checkpoint_engine._barrier``'s main-thread
+    constraint). Single process: trivially passes.
+    """
+    if timeout is None:
+        return barrier(group=group, log_name=log_name)
+    if jax.process_count() <= 1:
+        return barrier(group=group, log_name=log_name)
+    from jax._src import distributed
+
+    client = getattr(distributed.global_state, "client", None)
+    if client is None:  # jax.distributed not initialized with a coordinator
+        return barrier(group=group, log_name=log_name)
+    _monitored_barrier_seq[0] += 1
+    barrier_id = f"ds_tpu_{log_name}_{_monitored_barrier_seq[0]}"
+    try:
+        client.wait_at_barrier(barrier_id, int(float(timeout) * 1000))
+    except Exception as e:  # the service surfaces DEADLINE_EXCEEDED here
+        raise RuntimeError(f"monitored_barrier('{log_name}') timed out after {timeout}s — "
+                           f"a peer process is hung or dead ({e})") from e
+
+
 def log_summary(show_straggler: bool = False):
     return comms_logger.log_all(print_log=True, show_straggler=show_straggler)
 
@@ -250,10 +282,6 @@ def broadcast_object_list(object_list, src: int = 0, group=None, device=None):
         data = np.asarray(multihost_utils.broadcast_one_to_all(buf, is_source=is_src))
         object_list[:] = pickle.loads(data.tobytes())
     return object_list
-
-
-def monitored_barrier(group=None, timeout=None, wait_all_ranks=False):
-    return barrier(group)
 
 
 def get_all_ranks_from_group(group=None):

@@ -203,6 +203,8 @@ class DeepSpeedEngine:
         self.global_samples = 0
         self._skipped_host = 0
         self._skipped_dev = None  # lazily-summed device overflow flags (static-scale path)
+        self._last_overflow = None  # latest applied step's overflow flag (None = no step applied yet)
+        self._lr_override = None  # one-shot manual lr (set_lr) consumed by the next step
         self._grad_acc = None
         self._cached_grads = None
         self._last_loss = None
@@ -563,6 +565,7 @@ class DeepSpeedEngine:
 
     def step(self):
         if not self.is_gradient_accumulation_boundary():
+            self._last_overflow = None  # no-op step (reference was_step_applied contract)
             return
         self.timers(STEP_GLOBAL_TIMER).start()
         if (self.eigenvalue is not None
@@ -596,6 +599,7 @@ class DeepSpeedEngine:
                     self.params, self.opt_state, self._grad_acc, inv_scale, lr)
         self._grad_acc = None
         self._global_grad_norm = gnorm
+        self._last_overflow = overflow
         if self.loss_scaler.dynamic or self._host_offload is not None:
             # dynamic fp16 scaling needs the overflow bit on the host NOW
             # (the scale feeds the next step) — this device->host sync is
@@ -648,6 +652,11 @@ class DeepSpeedEngine:
         prof.end_profile()
 
     def _next_lr(self) -> float:
+        if self._lr_override is not None:
+            # reference set_lr semantics: the manual value drives the step(s)
+            # until the next scheduler recomputation
+            lr, self._lr_override = self._lr_override, None
+            return lr
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
             return float(self.lr_scheduler.get_last_lr()[0])
@@ -730,6 +739,45 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "_last_lr"):
             return self.lr_scheduler.get_last_lr()
         return [self._base_lr]
+
+    def set_lr(self, lr: float):
+        """Reference ``engine.py`` ``set_lr``: the manual value drives the
+        NEXT optimizer step; a configured scheduler resumes control after
+        its next recomputation (matching 'until the next scheduler.step()')."""
+        self._base_lr = float(lr)
+        self._lr_override = float(lr)
+
+    def set_train_batch_size(self, train_batch_size: int):
+        """Adjust the global batch size by changing the number of gradient
+        accumulation steps; micro-batch size and DP degree are fixed
+        (reference ``engine.py:411``)."""
+        self._check_no_pending_fused("set_train_batch_size")
+        micro_dp = self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
+        if train_batch_size % micro_dp != 0:
+            raise ValueError(f"train_batch_size {train_batch_size} must be divisible by "
+                             f"micro-batch x data parallelism ({micro_dp})")
+        self.gradient_accumulation_steps = train_batch_size // micro_dp
+        self.config.gradient_accumulation_steps = self.gradient_accumulation_steps
+        self.config.train_batch_size = train_batch_size
+        if self.gradient_accumulation_steps != 1 and self._fused_step is not None:
+            # the fused one-dispatch step is only valid at gas=1 (it applies
+            # the optimizer on every forward); fall back to the split path
+            self._fused_step = None
+            log_dist("set_train_batch_size: gas > 1 — fused one-dispatch step disabled", ranks=[0])
+
+    def gradient_clipping(self) -> float:
+        return self.config.gradient_clipping
+
+    def dynamic_loss_scale(self) -> bool:
+        return bool(self.loss_scaler.dynamic)
+
+    def was_step_applied(self) -> bool:
+        """True iff the latest ``step()`` modified parameters — False for
+        accumulation-boundary no-ops and overflow-skipped steps (reference
+        ``engine.py:1682``). Querying syncs the overflow flag."""
+        if self._last_overflow is None:
+            return False
+        return not bool(self._last_overflow)
 
     def get_loss_scale(self) -> float:
         return self.loss_scaler.loss_scale

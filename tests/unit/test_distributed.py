@@ -196,3 +196,31 @@ for _ in range(3):  # repeated barriers must not deadlock or skew
 print("LADDER_OK", RANK)
 """, n_procs=4, devices_per_proc=1)
     assert all("LADDER_OK" in o for o in out)
+
+
+def test_monitored_barrier_multiprocess():
+    """monitored_barrier over 2 REAL processes: timed host-level barrier
+    passes when peers arrive, and RAISES (DEADLINE) when one never does
+    (reference comm.py:412 gloo hang-detection semantics)."""
+    out = run_distributed("""
+import time
+import deepspeed_tpu.comm as dist
+
+dist.init_distributed(verbose=False)
+if RANK == 1:
+    time.sleep(1.0)  # straggler within budget
+dist.monitored_barrier(timeout=60.0)
+print("MB_PASS", RANK)
+
+# rank 1 never shows up for the second barrier: rank 0 must RAISE, not hang
+if RANK == 0:
+    try:
+        dist.monitored_barrier(timeout=3.0, log_name="abandoned")
+        print("MB_NOT_RAISED")
+    except RuntimeError as e:
+        print("MB_TIMEOUT_OK")
+else:
+    time.sleep(6.0)  # outlive rank 0's deadline without joining
+""")
+    assert all("MB_PASS" in o for o in out)
+    assert "MB_TIMEOUT_OK" in out[0] and "MB_NOT_RAISED" not in out[0]

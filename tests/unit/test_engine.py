@@ -252,3 +252,50 @@ def test_save_16bit_model(tmp_path):
     tree = engine.params.get("params", engine.params)
     want = np.asarray(jax.device_get(tree["wte"]), np.float32)
     np.testing.assert_allclose(got, want, rtol=1e-2, atol=1e-2)
+
+
+def test_engine_accessor_parity():
+    """set_train_batch_size / set_lr / was_step_applied / gradient_clipping
+    (reference engine.py:411,1682 and the accessor family)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, n_layers=1, n_heads=2, d_model=32, max_seq_len=32)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "gradient_clipping": 0.7,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "mesh": {"data": 8},
+    })
+    assert engine.gradient_clipping() == 0.7
+    assert engine.dynamic_loss_scale() is False
+    assert engine.was_step_applied() is False  # nothing ran yet
+
+    rng = np.random.RandomState(0)
+    batch = engine._put_batch({"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)})
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()  # mid-accumulation: no-op
+    assert engine.was_step_applied() is False
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    engine.step()  # boundary: applied
+    assert engine.was_step_applied() is True
+
+    # global batch 8*1*dp8? dp=8 -> micro_dp=8; 32 -> gas 4
+    engine.set_train_batch_size(32)
+    assert engine.gradient_accumulation_steps == 4
+    with pytest.raises(ValueError):
+        engine.set_train_batch_size(12)
+    engine.set_lr(5e-4)
+    assert engine.get_lr() == [5e-4]
+
+
+def test_monitored_barrier():
+    from deepspeed_tpu import comm as dist
+
+    dist.monitored_barrier()  # no timeout: plain barrier
+    dist.monitored_barrier(timeout=30.0)  # single process: passes quickly
