@@ -10,6 +10,7 @@ Top-level API parity (reference ``deepspeed/__init__.py``):
 
 from . import comm
 from .accelerator import get_accelerator
+from .comm import init_distributed  # reference deepspeed.init_distributed (deepspeed/__init__.py)
 from .runtime.config import DeepSpeedConfig
 from .utils import groups, logger
 from .version import __version__

@@ -118,6 +118,7 @@ def barrier(group=None, log_name: str = "barrier"):
 
 
 _monitored_barrier_seq = [0]
+_monitored_barrier_warned: list = []
 
 
 def monitored_barrier(group=None, timeout: Optional[float] = None, wait_all_ranks: bool = False,
@@ -140,13 +141,20 @@ def monitored_barrier(group=None, timeout: Optional[float] = None, wait_all_rank
     client = getattr(distributed.global_state, "client", None)
     if client is None:  # jax.distributed not initialized with a coordinator
         return barrier(group=group, log_name=log_name)
+    if wait_all_ranks and not _monitored_barrier_warned:
+        _monitored_barrier_warned.append(True)
+        logger.warning("monitored_barrier: wait_all_ranks is accepted for signature parity but the "
+                       "coordination service reports the first missing peer only")
     _monitored_barrier_seq[0] += 1
     barrier_id = f"ds_tpu_{log_name}_{_monitored_barrier_seq[0]}"
     try:
         client.wait_at_barrier(barrier_id, int(float(timeout) * 1000))
-    except Exception as e:  # the service surfaces DEADLINE_EXCEEDED here
-        raise RuntimeError(f"monitored_barrier('{log_name}') timed out after {timeout}s — "
-                           f"a peer process is hung or dead ({e})") from e
+    except Exception as e:
+        msg = str(e).upper()
+        if "DEADLINE" in msg or "TIMED OUT" in msg or "TIMEOUT" in msg:
+            raise RuntimeError(f"monitored_barrier('{log_name}') timed out after {timeout}s — "
+                               f"a peer process is hung or dead ({e})") from e
+        raise  # not a timeout (coordinator down, duplicate id, ...): keep the real diagnosis
 
 
 def log_summary(show_straggler: bool = False):

@@ -205,6 +205,7 @@ class DeepSpeedEngine:
         self._skipped_dev = None  # lazily-summed device overflow flags (static-scale path)
         self._last_overflow = None  # latest applied step's overflow flag (None = no step applied yet)
         self._lr_override = None  # one-shot manual lr (set_lr) consumed by the next step
+        self._accum_base = 0  # micro_steps value at the start of the current accumulation regime
         self._grad_acc = None
         self._cached_grads = None
         self._last_loss = None
@@ -401,9 +402,12 @@ class DeepSpeedEngine:
         # IS a full step and no host-side stage interposes.
         self._fused_step = None
         self._fused_pending = None
-        if (self.gradient_accumulation_steps == 1 and comp is None and not use_zeropp
+        if (comp is None and not use_zeropp
                 and self._host_offload is None and self.eigenvalue is None
                 and self.config.fused_step):
+            # built whenever eligible (compiles lazily on first use); USED
+            # only while gas == 1 — set_train_batch_size can move gas in
+            # either direction at runtime
 
             def fused_step(params32, opt_state, batch, step, scale, inv_scale, lr):
                 rng = jax.random.fold_in(base_rng, step)
@@ -417,7 +421,7 @@ class DeepSpeedEngine:
             self._fused_step = jax.jit(
                 fused_step, donate_argnums=(0, 1),
                 out_shardings=(None, param_out_shardings, self.opt_state_shardings, None, None))
-            if self.config.wall_clock_breakdown:
+            if self.config.wall_clock_breakdown and self.gradient_accumulation_steps == 1:
                 log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
                          "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
 
@@ -519,10 +523,11 @@ class DeepSpeedEngine:
         scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
         profiling = (self.config.flops_profiler.enabled
                      and self.global_steps == self.config.flops_profiler.profile_step
-                     and self.micro_steps % self.gradient_accumulation_steps == 0)  # first micro-batch only
+                     and (self.micro_steps - self._accum_base) % self.gradient_accumulation_steps == 0)  # first micro-batch only
         if profiling:
             self._start_flops_profile(batch, self.micro_steps, scale)
-        if self._fused_step is not None and not profiling and getattr(self, "_training", True):
+        if (self._fused_step is not None and self.gradient_accumulation_steps == 1
+                and not profiling and getattr(self, "_training", True)):
             lr = self._next_lr()
             inv_scale = 1.0 / self.loss_scaler.loss_scale
             loss, self.params, self.opt_state, gnorm, overflow = self._fused_step(
@@ -561,7 +566,8 @@ class DeepSpeedEngine:
 
     def is_gradient_accumulation_boundary(self) -> bool:
         """Reference ``engine.py:2009``."""
-        return self.micro_steps % self.gradient_accumulation_steps == 0 and self.micro_steps > 0
+        done = self.micro_steps - self._accum_base
+        return done % self.gradient_accumulation_steps == 0 and done > 0
 
     def step(self):
         if not self.is_gradient_accumulation_boundary():
@@ -652,13 +658,14 @@ class DeepSpeedEngine:
         prof.end_profile()
 
     def _next_lr(self) -> float:
+        if self.lr_scheduler is not None:
+            # the schedule clock ALWAYS advances (reference: scheduler.step()
+            # runs every step; a manual set_lr only masks one recomputation)
+            self.lr_scheduler.step()
         if self._lr_override is not None:
-            # reference set_lr semantics: the manual value drives the step(s)
-            # until the next scheduler recomputation
             lr, self._lr_override = self._lr_override, None
             return lr
         if self.lr_scheduler is not None:
-            self.lr_scheduler.step()
             return float(self.lr_scheduler.get_last_lr()[0])
         return float(self._base_lr)
 
@@ -713,6 +720,10 @@ class DeepSpeedEngine:
                 "if your loop needs discardable forwards")
         self._grad_acc = None
         self._cached_grads = None
+        # discarding a partial window restarts the accumulation clock, so
+        # the next step applies exactly gas fresh micro-grads (same
+        # mis-scaling hazard set_train_batch_size guards against)
+        self._accum_base = self.micro_steps
 
     # ------------------------------------------------------------------
     # introspection (reference engine accessors)
@@ -736,6 +747,8 @@ class DeepSpeedEngine:
         return self.config.zero_enabled
 
     def get_lr(self):
+        if self._lr_override is not None:  # pending manual override (set_lr)
+            return [self._lr_override]
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "_last_lr"):
             return self.lr_scheduler.get_last_lr()
         return [self._base_lr]
@@ -752,18 +765,25 @@ class DeepSpeedEngine:
         accumulation steps; micro-batch size and DP degree are fixed
         (reference ``engine.py:411``)."""
         self._check_no_pending_fused("set_train_batch_size")
+        if self._grad_acc is not None or (self._cached_grads is not None and self._cached_grads is not _FUSED):
+            raise RuntimeError("set_train_batch_size mid-accumulation: step() the pending micro-batches "
+                               "first (mixing 1/gas-scaled gradients across regimes would mis-scale them)")
         micro_dp = self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
-        if train_batch_size % micro_dp != 0:
-            raise ValueError(f"train_batch_size {train_batch_size} must be divisible by "
+        if train_batch_size < micro_dp or train_batch_size % micro_dp != 0:
+            raise ValueError(f"train_batch_size {train_batch_size} must be a positive multiple of "
                              f"micro-batch x data parallelism ({micro_dp})")
         self.gradient_accumulation_steps = train_batch_size // micro_dp
         self.config.gradient_accumulation_steps = self.gradient_accumulation_steps
         self.config.train_batch_size = train_batch_size
-        if self.gradient_accumulation_steps != 1 and self._fused_step is not None:
-            # the fused one-dispatch step is only valid at gas=1 (it applies
-            # the optimizer on every forward); fall back to the split path
-            self._fused_step = None
-            log_dist("set_train_batch_size: gas > 1 — fused one-dispatch step disabled", ranks=[0])
+        # the boundary clock restarts here so the next window is exactly gas
+        # micro-batches regardless of the cumulative micro_steps residue
+        self._accum_base = self.micro_steps
+        if self._fused_step is not None:
+            # forward() gates the fused one-dispatch path on gas == 1 — no
+            # state to juggle here, just say which path the new gas takes
+            log_dist(f"set_train_batch_size: gas={self.gradient_accumulation_steps} — "
+                     f"fused one-dispatch step {'active' if self.gradient_accumulation_steps == 1 else 'inactive'}",
+                     ranks=[0])
 
     def gradient_clipping(self) -> float:
         return self.config.gradient_clipping
@@ -876,7 +896,7 @@ class DeepSpeedEngine:
             # optimizer states) can still restore step-indexed schedules
             with open(os.path.join(d, TRAIN_META_FILENAME), "w") as f:
                 json.dump({"global_steps": self.global_steps, "micro_steps": self.micro_steps,
-                           "global_samples": self.global_samples}, f)
+                           "global_samples": self.global_samples, "accum_base": self._accum_base}, f)
         if self.curriculum_scheduler is not None:
             # own file: plain-python state, no array template needed on load
             self.checkpoint_engine.save(self.curriculum_scheduler.get_state(),
@@ -942,6 +962,16 @@ class DeepSpeedEngine:
                     self.lr_scheduler.load_state_dict(state["lr_scheduler"])
                 self.global_steps = int(state["global_steps"])
                 self.micro_steps = int(state["micro_steps"])
+                # accum_base rides the JSON meta (kept OUT of the msgpack
+                # template so pre-existing checkpoints still deserialize)
+                meta_path = os.path.join(d, TRAIN_META_FILENAME)
+                if os.path.exists(meta_path):
+                    with open(meta_path) as f:
+                        self._accum_base = int(json.load(f).get("accum_base", 0))
+                else:  # meta-less checkpoint: never leave a stale clock ahead
+                    self._accum_base = 0
+                if self._accum_base > self.micro_steps:
+                    self._accum_base = self.micro_steps
                 self.global_samples = int(state["global_samples"])
                 self.skipped_steps = int(state["skipped_steps"])
                 if self.progressive_layer_drop is not None:

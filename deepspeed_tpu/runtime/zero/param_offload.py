@@ -95,13 +95,32 @@ def probe_jit_streaming(mesh) -> bool:
 
     host = NamedSharding(mesh, P(), memory_kind=_HOST_KIND)
     dev = NamedSharding(mesh, P(), memory_kind="device")
+    # the failure path is EXPECTED on CPU meshes; XLA's C++ RET_CHECK dumps
+    # an error + stack trace to fd 2 even though we catch the exception —
+    # swallow stderr for the duration so probe noise never pollutes logs
+    # (the driver records the tail of dryrun output)
+    import os as _os
+
+    saved_err = devnull = None
     try:
+        try:  # fd juggling must not break the fail-safe probe (closed stderr etc.)
+            saved_err = _os.dup(2)
+            devnull = _os.open(_os.devnull, _os.O_WRONLY)
+            _os.dup2(devnull, 2)
+        except OSError:
+            pass
         x = jax.device_put(jnp.zeros((4,), jnp.float32), host)
         fn = jax.jit(lambda a: jax.device_put(a, dev) * 2, out_shardings=host)
         fn.lower(x).compile()
         return True
     except Exception:
         return False
+    finally:
+        if saved_err is not None:
+            _os.dup2(saved_err, 2)
+            _os.close(saved_err)
+        if devnull is not None:
+            _os.close(devnull)
 
 
 def maybe_enable_param_offload(config, topology, param_shardings, param_shapes):

@@ -192,7 +192,11 @@ class TestFusedStep:
 
     def test_gas_gt_1_uses_split_path(self):
         engine = _make_engine(stage=0)  # helper default gas=2
-        assert engine._fused_step is None
+        # the fused step is BUILT (so set_train_batch_size can enable it
+        # later) but gated off at call time while gas > 1
+        b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
+        engine.forward(b)
+        assert engine._fused_pending is None
 
     def test_eval_mode_bypasses_fused(self):
         engine = _make_engine(stage=0, extra={"gradient_accumulation_steps": 1}, lr=1e-1)
@@ -285,13 +289,124 @@ def test_engine_accessor_parity():
     engine.step()  # boundary: applied
     assert engine.was_step_applied() is True
 
-    # global batch 8*1*dp8? dp=8 -> micro_dp=8; 32 -> gas 4
+    # dp=8 -> micro_dp=8; 32 -> gas 4. The boundary clock restarts at the
+    # call, so the NEXT window is exactly 4 micro-batches.
     engine.set_train_batch_size(32)
     assert engine.gradient_accumulation_steps == 4
+    for i in range(4):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+        assert engine.was_step_applied() == (i == 3), i
     with pytest.raises(ValueError):
         engine.set_train_batch_size(12)
+    # mid-accumulation regime changes are refused (mixed 1/gas scaling)
+    loss = engine.forward(batch)
+    engine.backward(loss)
+    with pytest.raises(RuntimeError, match="mid-accumulation"):
+        engine.set_train_batch_size(8)
+    for _ in range(3):
+        loss = engine.forward(batch)
+        engine.backward(loss)
+    engine.step()
     engine.set_lr(5e-4)
     assert engine.get_lr() == [5e-4]
+
+
+def test_set_train_batch_size_fused_restore():
+    """gas=1 engines own a fused one-dispatch step; growing the batch
+    disables it, shrinking back restores it."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, n_layers=1, n_heads=2, d_model=32, max_seq_len=32)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1, "bf16": {"enabled": True},
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "mesh": {"data": 8},
+    })
+    assert engine._fused_step is not None
+    engine.set_train_batch_size(16)   # gas 2: fused path gated off
+    rng = np.random.RandomState(0)
+    batch = engine._put_batch({"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)})
+    for i in range(2):
+        loss = engine.forward(batch)
+        assert engine._fused_pending is None  # split path while gas > 1
+        engine.backward(loss)
+        engine.step()
+    assert engine.was_step_applied()
+    engine.set_train_batch_size(8)    # back to gas 1: fused path active again
+    loss = engine.forward(batch)
+    assert engine._fused_pending is not None  # fused consumed this forward
+    engine.backward(loss)
+    engine.step()
+    assert engine.was_step_applied()
+    with pytest.raises(ValueError):
+        engine.set_train_batch_size(0)  # gas 0 must be refused
+
+
+def test_set_train_batch_size_fused_late_enable():
+    """An engine INITIALIZED at gas=2 still gains the fused one-dispatch
+    path when later shrunk to gas=1 (the builder no longer depends on the
+    init-time gas)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, n_layers=1, n_heads=2, d_model=32, max_seq_len=32)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1, "gradient_accumulation_steps": 2,
+        "bf16": {"enabled": True},
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "mesh": {"data": 8},
+    })
+    assert engine._fused_step is not None  # built; gated off by gas
+    engine.set_train_batch_size(8)  # gas 1
+    rng = np.random.RandomState(0)
+    batch = engine._put_batch({"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)})
+    loss = engine.forward(batch)
+    assert engine._fused_pending is not None
+    engine.backward(loss)
+    engine.step()
+    assert engine.was_step_applied()
+
+
+def test_set_lr_with_scheduler_keeps_clock():
+    """set_lr drives exactly one step; the scheduler clock still advances
+    every step (no permanent one-step schedule offset)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, n_layers=1, n_heads=2, d_model=32, max_seq_len=32)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
+        "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 10, "warmup_max_lr": 1e-3}},
+        "mesh": {"data": 8}, "fused_step": False,
+    })
+    rng = np.random.RandomState(0)
+    batch = engine._put_batch({"input_ids": rng.randint(0, 64, (8, 16)).astype(np.int32)})
+
+    def one():
+        loss = engine.forward(batch)
+        engine.backward(loss)
+        engine.step()
+
+    one()
+    sched_lr_after_1 = engine.get_lr()[0]
+    engine.set_lr(7e-4)
+    assert engine.get_lr() == [7e-4]  # pending override visible
+    one()  # override consumed; scheduler clock advanced too
+    assert engine._lr_override is None
+    one()
+    # after 3 steps the scheduler reports its step-3 value (clock unskewed):
+    # warmup is monotonic, so lr(3) > lr(1)
+    assert engine.get_lr()[0] > sched_lr_after_1
 
 
 def test_monitored_barrier():
