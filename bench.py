@@ -315,6 +315,10 @@ def main():
     n_dev, platform = _probe_backend()
 
     import jax
+
+    from deepspeed_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(jax, os.path.dirname(os.path.abspath(__file__)))
     import jax.numpy as jnp
     import numpy as np
 

@@ -765,7 +765,8 @@ class DeepSpeedEngine:
         accumulation steps; micro-batch size and DP degree are fixed
         (reference ``engine.py:411``)."""
         self._check_no_pending_fused("set_train_batch_size")
-        if self._grad_acc is not None or (self._cached_grads is not None and self._cached_grads is not _FUSED):
+        if self._grad_acc is not None or self._cached_grads is not None:
+            # (a fused _FUSED marker can't reach here: _check_no_pending_fused raised)
             raise RuntimeError("set_train_batch_size mid-accumulation: step() the pending micro-batches "
                                "first (mixing 1/gas-scaled gradients across regimes would mis-scale them)")
         micro_dp = self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
@@ -775,15 +776,20 @@ class DeepSpeedEngine:
         self.gradient_accumulation_steps = train_batch_size // micro_dp
         self.config.gradient_accumulation_steps = self.gradient_accumulation_steps
         self.config.train_batch_size = train_batch_size
+        self.train_batch_size = train_batch_size
+        self.tput_timer.batch_size = train_batch_size  # samples/sec stays honest
         # the boundary clock restarts here so the next window is exactly gas
         # micro-batches regardless of the cumulative micro_steps residue
         self._accum_base = self.micro_steps
         if self._fused_step is not None:
             # forward() gates the fused one-dispatch path on gas == 1 — no
             # state to juggle here, just say which path the new gas takes
+            fused_on = self.gradient_accumulation_steps == 1
             log_dist(f"set_train_batch_size: gas={self.gradient_accumulation_steps} — "
-                     f"fused one-dispatch step {'active' if self.gradient_accumulation_steps == 1 else 'inactive'}",
-                     ranks=[0])
+                     f"fused one-dispatch step {'active' if fused_on else 'inactive'}", ranks=[0])
+            if fused_on and self.config.wall_clock_breakdown:
+                log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
+                         "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
 
     def gradient_clipping(self) -> float:
         return self.config.gradient_clipping

@@ -1,0 +1,16 @@
+"""Persistent XLA compilation-cache policy shared by the hardware tools."""
+
+import os
+
+
+def enable_compilation_cache(jax, repo_root: str, env_gate: str = "DS_BENCH_NO_CACHE"):
+    """Point jax at the repo-local compile cache unless ``env_gate`` =1.
+
+    One definition of the policy (dir name, 1s min-compile threshold) for
+    bench.py and tools/hw_smoke.py — on the tunneled chip every skipped
+    compile is ~20-40s less wedge-risk window.
+    """
+    if os.environ.get(env_gate) == "1":
+        return
+    jax.config.update("jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache_tpu"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

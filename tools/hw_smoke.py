@@ -162,6 +162,10 @@ def _ops():
 def main():
     import jax
 
+    from deepspeed_tpu.utils.compile_cache import enable_compilation_cache
+
+    enable_compilation_cache(jax, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
     plat = jax.devices()[0].platform
     print(f"[hw_smoke] platform={plat}")
     if plat != "tpu":
