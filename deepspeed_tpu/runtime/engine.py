@@ -422,8 +422,7 @@ class DeepSpeedEngine:
                 fused_step, donate_argnums=(0, 1),
                 out_shardings=(None, param_out_shardings, self.opt_state_shardings, None, None))
             if self.config.wall_clock_breakdown and self.gradient_accumulation_steps == 1:
-                log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
-                         "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
+                self._log_fused_timer_note()
 
         def eval_loss(params32, batch, rng):
             params_c = _cast_tree(_fetch(params32), compute_dtype)
@@ -777,7 +776,12 @@ class DeepSpeedEngine:
         self.config.gradient_accumulation_steps = self.gradient_accumulation_steps
         self.config.train_batch_size = train_batch_size
         self.train_batch_size = train_batch_size
-        self.tput_timer.batch_size = train_batch_size  # samples/sec stays honest
+        # new throughput window: retroactively applying the new batch size
+        # to already-timed steps would mis-scale avg samples/sec
+        self.tput_timer.batch_size = max(1, train_batch_size)
+        self.tput_timer.total_elapsed_time = 0.0
+        self.tput_timer.global_step_count = 0
+        self.tput_timer.micro_step_count = 0
         # the boundary clock restarts here so the next window is exactly gas
         # micro-batches regardless of the cumulative micro_steps residue
         self._accum_base = self.micro_steps
@@ -788,8 +792,12 @@ class DeepSpeedEngine:
             log_dist(f"set_train_batch_size: gas={self.gradient_accumulation_steps} — "
                      f"fused one-dispatch step {'active' if fused_on else 'inactive'}", ranks=[0])
             if fused_on and self.config.wall_clock_breakdown:
-                log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
-                         "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
+                self._log_fused_timer_note()
+
+    @staticmethod
+    def _log_fused_timer_note():
+        log_dist("fused_step active: the 'forward' wall-clock bucket covers the whole "
+                 "fwd+bwd+optimizer dispatch; the backward/step timers measure nothing", ranks=[0])
 
     def gradient_clipping(self) -> float:
         return self.config.gradient_clipping

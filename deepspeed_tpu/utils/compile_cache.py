@@ -1,16 +1,22 @@
-"""Persistent XLA compilation-cache policy shared by the hardware tools."""
+"""Persistent XLA compilation-cache policy (single definition).
+
+Used by the test conftest (CPU suite) and the hardware tools (bench.py,
+tools/hw_smoke.py) — on the tunneled chip every cache hit is ~20-40s less
+mid-compile wedge-risk window; on CPU CI it halves warm reruns.
+"""
 
 import os
 
+MIN_COMPILE_TIME_SECS = 1.0
 
-def enable_compilation_cache(jax, repo_root: str, env_gate: str = "DS_BENCH_NO_CACHE"):
-    """Point jax at the repo-local compile cache unless ``env_gate`` =1.
 
-    One definition of the policy (dir name, 1s min-compile threshold) for
-    bench.py and tools/hw_smoke.py — on the tunneled chip every skipped
-    compile is ~20-40s less wedge-risk window.
+def enable_compilation_cache(jax, default_dir: str, env_gate: str = "DS_BENCH_NO_CACHE",
+                             env_dir: str = "JAX_COMPILATION_CACHE_DIR"):
+    """Point jax at a persistent compile cache unless ``env_gate`` =1.
+
+    ``env_dir`` (when set) overrides ``default_dir``.
     """
     if os.environ.get(env_gate) == "1":
         return
-    jax.config.update("jax_compilation_cache_dir", os.path.join(repo_root, ".jax_cache_tpu"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_compilation_cache_dir", os.environ.get(env_dir, default_dir))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", MIN_COMPILE_TIME_SECS)

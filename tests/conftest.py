@@ -31,11 +31,10 @@ jax.config.update("jax_platforms", "cpu")
 # CI reruns recompile identical toy HLO — warm runs cut test wall time ~2x
 # (measured 24s -> 12s on the heaviest zeropp oracle). Keyed by HLO hash, so
 # code changes re-compile exactly what changed. DS_TEST_NO_CACHE=1 disables.
-if os.environ.get("DS_TEST_NO_CACHE") != "1":
-    _cache_dir = os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                os.path.join(os.path.dirname(__file__), ".jax_cache"))
-    jax.config.update("jax_compilation_cache_dir", _cache_dir)
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from deepspeed_tpu.utils.compile_cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache(jax, os.path.join(os.path.dirname(__file__), ".jax_cache"),
+                         env_gate="DS_TEST_NO_CACHE")
 
 import pytest  # noqa: E402
 

@@ -75,3 +75,27 @@ def test_ring_gradients_match():
     gr = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
     for a, b in zip(gd, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+def test_ulysses_with_zero3_matches_dp():
+    """SP x ZeRO-3 composition (the reference's blog-claimed combination:
+    Ulysses 'combinable with ZeRO-3', SURVEY §5 long-context row): same
+    one-step loss as plain data parallel."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=64, n_layers=2, n_heads=4, d_model=32, max_seq_len=32)
+    model = CausalLM(cfg)
+    init = lambda: model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 16), np.int32)})
+    batch = {"input_ids": np.random.RandomState(0).randint(0, 64, (4, 16)).astype(np.int32)}
+    opt = {"type": "adam", "params": {"lr": 1e-3}}
+
+    esp, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=init(), config={
+        "train_micro_batch_size_per_gpu": 1, "optimizer": opt,
+        "zero_optimization": {"stage": 3}, "mesh": {"data": 2, "fsdp": 2, "seq": 2}})
+    loss_sp = float(esp.train_batch(iter([batch])))
+
+    edp, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=init(), config={
+        "train_micro_batch_size_per_gpu": 1, "optimizer": opt, "mesh": {"data": 4, "tensor": 2}})
+    loss_dp = float(edp.train_batch(iter([batch])))
+    assert abs(loss_sp - loss_dp) < 5e-3, (loss_sp, loss_dp)

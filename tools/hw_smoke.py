@@ -164,7 +164,7 @@ def main():
 
     from deepspeed_tpu.utils.compile_cache import enable_compilation_cache
 
-    enable_compilation_cache(jax, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    enable_compilation_cache(jax, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), '.jax_cache_tpu'))
 
     plat = jax.devices()[0].platform
     print(f"[hw_smoke] platform={plat}")
