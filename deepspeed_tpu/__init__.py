@@ -15,9 +15,6 @@ from .runtime.config import DeepSpeedConfig
 from .utils import groups, logger
 from .version import __version__
 
-# populated lazily to keep import light until the engine lands
-_ENGINE_EXPORTS = {}
-
 
 def initialize(args=None,
                model=None,
@@ -51,6 +48,48 @@ def init_inference(model=None, config=None, **kwargs):
     return _init_inference(model=model, config=config, **kwargs)
 
 
+def default_inference_config():
+    """Default v1 inference config dict (reference ``deepspeed/__init__.py:266``)."""
+    import dataclasses
+
+    from .inference.config import DeepSpeedInferenceConfig
+
+    return dataclasses.asdict(DeepSpeedInferenceConfig())
+
+
+def add_config_arguments(parser):
+    """Attach the reference's ``--deepspeed``/``--deepspeed_config`` CLI
+    flags to an argparse parser (reference ``deepspeed/__init__.py:250``)."""
+    group = parser.add_argument_group("DeepSpeed", "DeepSpeed configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed (helper flag for user code; the engine activates via config)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to the DeepSpeed json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true",
+                       help="Deprecated alias of --deepspeed")
+    group.add_argument("--deepscale_config", default=None, type=str,
+                       help="Deprecated alias of --deepspeed_config")
+    return parser
+
+
+# reference top-level class/helper surface (deepspeed/__init__.py:25-50),
+# resolved lazily so `import deepspeed_tpu` stays light
+_LAZY_NAMES = {
+    "DeepSpeedEngine": ("deepspeed_tpu.runtime.engine", "DeepSpeedEngine"),
+    "DeepSpeedHybridEngine": ("deepspeed_tpu.runtime.hybrid_engine", "DeepSpeedHybridEngine"),
+    "PipelineEngine": ("deepspeed_tpu.runtime.pipe.engine", "PipelineEngine"),
+    "PipelineModule": ("deepspeed_tpu.runtime.pipe.module", "PipelineModule"),
+    "InferenceEngine": ("deepspeed_tpu.inference.engine", "InferenceEngine"),
+    "DeepSpeedInferenceConfig": ("deepspeed_tpu.inference.config", "DeepSpeedInferenceConfig"),
+    "DeepSpeedTransformerLayer": ("deepspeed_tpu.ops.transformer.transformer_layer", "DeepSpeedTransformerLayer"),
+    "DeepSpeedTransformerConfig": ("deepspeed_tpu.ops.transformer.transformer_layer", "DeepSpeedTransformerConfig"),
+    "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
+    "OnDevice": ("deepspeed_tpu.utils.init_on_device", "OnDevice"),
+    "ADAM_OPTIMIZER": ("deepspeed_tpu.runtime.optimizers", "ADAM_OPTIMIZER"),
+    "LAMB_OPTIMIZER": ("deepspeed_tpu.runtime.optimizers", "LAMB_OPTIMIZER"),
+}
+
+
 def __getattr__(name):
     # Lazy submodule access: deepspeed_tpu.zero, .moe, .pipe, .ops, ...
     import importlib
@@ -60,4 +99,7 @@ def __getattr__(name):
             "utils", "accelerator"}
     if name in lazy:
         return importlib.import_module(f".{name}", __name__)
+    if name in _LAZY_NAMES:
+        mod, attr = _LAZY_NAMES[name]
+        return getattr(importlib.import_module(mod), attr)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -86,3 +86,23 @@ def test_mesh_config_defaults():
 
 # quick tier: `pytest -m fast` smoke run
 pytestmark = pytest.mark.fast
+
+
+def test_top_level_surface_parity():
+    """The reference's `deepspeed/__init__.py` public names resolve at our
+    top level (lazy) so `from deepspeed import X` ports mechanically."""
+    import argparse
+
+    import deepspeed_tpu as ds
+
+    for n in ("initialize", "init_inference", "init_distributed", "get_accelerator",
+              "DeepSpeedEngine", "DeepSpeedHybridEngine", "PipelineEngine", "PipelineModule",
+              "InferenceEngine", "DeepSpeedInferenceConfig", "DeepSpeedConfig",
+              "DeepSpeedTransformerLayer", "DeepSpeedTransformerConfig",
+              "log_dist", "OnDevice", "logger", "ADAM_OPTIMIZER", "LAMB_OPTIMIZER", "__version__"):
+        assert getattr(ds, n) is not None, n
+    assert isinstance(ds.default_inference_config(), dict)
+    args = ds.add_config_arguments(argparse.ArgumentParser()).parse_args(["--deepspeed"])
+    assert args.deepspeed is True
+    # the zero / pipe packages resolve like the reference's
+    assert ds.zero.Init is not None and ds.pipe.PipelineModule is not None
