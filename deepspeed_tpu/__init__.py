@@ -50,11 +50,9 @@ def init_inference(model=None, config=None, **kwargs):
 
 def default_inference_config():
     """Default v1 inference config dict (reference ``deepspeed/__init__.py:266``)."""
-    import dataclasses
-
     from .inference.config import DeepSpeedInferenceConfig
 
-    return dataclasses.asdict(DeepSpeedInferenceConfig())
+    return DeepSpeedInferenceConfig().to_dict()
 
 
 def add_config_arguments(parser):
@@ -86,6 +84,7 @@ _LAZY_NAMES = {
     "log_dist": ("deepspeed_tpu.utils.logging", "log_dist"),
     "OnDevice": ("deepspeed_tpu.utils.init_on_device", "OnDevice"),
     "ADAM_OPTIMIZER": ("deepspeed_tpu.runtime.optimizers", "ADAM_OPTIMIZER"),
+    "checkpointing": ("deepspeed_tpu.runtime.activation_checkpointing", "checkpointing"),
     "LAMB_OPTIMIZER": ("deepspeed_tpu.runtime.optimizers", "LAMB_OPTIMIZER"),
 }
 

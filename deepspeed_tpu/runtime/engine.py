@@ -802,6 +802,10 @@ class DeepSpeedEngine:
     def gradient_clipping(self) -> float:
         return self.config.gradient_clipping
 
+    def zero_gather_16bit_weights_on_model_save(self) -> bool:
+        """Reference ``engine.py:773`` accessor."""
+        return bool(self.config.zero_config.stage3_gather_16bit_weights_on_model_save)
+
     def dynamic_loss_scale(self) -> bool:
         return bool(self.loss_scaler.dynamic)
 
@@ -915,6 +919,10 @@ class DeepSpeedEngine:
             # own file: plain-python state, no array template needed on load
             self.checkpoint_engine.save(self.curriculum_scheduler.get_state(),
                                         os.path.join(d, CURRICULUM_STATE_FILENAME))
+        if self.zero_gather_16bit_weights_on_model_save():
+            # reference engine.py:3049 -> _save_zero_checkpoint + gathered
+            # 16-bit model export when stage3_gather_16bit... is set
+            self.save_16bit_model(d)
         if client_state:
             self.checkpoint_engine.save(client_state, os.path.join(d, CLIENT_STATE_FILENAME))
         if save_latest and jax.process_index() == 0:
@@ -926,6 +934,32 @@ class DeepSpeedEngine:
     def load_checkpoint(self, load_dir: str, tag=None, load_module_strict: bool = True,
                         load_optimizer_states: bool = True, load_lr_scheduler_states: bool = True,
                         load_module_only: bool = False):
+        if self.config.checkpoint_config.load_universal:
+            # reference checkpoint.load_universal=true routes resume through
+            # the degree-independent layout (universal_checkpoint.py:22),
+            # keeping this method's contract: (path, client_state) return,
+            # warn-and-fresh-start on a missing 'latest', fused-pending
+            # handling identical to the regular route
+            from ..checkpoint.universal import LATEST_FILENAME as UNI_LATEST
+
+            if load_module_only or not load_lr_scheduler_states:
+                raise NotImplementedError("universal checkpoints restore the full training state; "
+                                          "module-only / no-scheduler loads need the native layout")
+            if tag is None and not os.path.exists(os.path.join(load_dir, UNI_LATEST)):
+                logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
+                return None, {}
+            if self._fused_pending is not None:
+                if not load_optimizer_states:
+                    raise RuntimeError("load_checkpoint: a fused step is pending and this partial load "
+                                       "(load_optimizer_states=False) would not overwrite the optimizer "
+                                       "state it touched; call step() first")
+                self._fused_pending = None
+                self._cached_grads = None
+                log_dist("load_checkpoint: discarding a pending fused step — its state is being overwritten",
+                         ranks=[0])
+            path = self.load_universal_checkpoint(load_dir, tag=tag,
+                                                  load_optimizer_states=load_optimizer_states)
+            return path, {}
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILENAME)
             if not os.path.exists(latest):
