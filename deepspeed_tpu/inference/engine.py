@@ -137,14 +137,16 @@ def init_inference(model=None, config=None, **kwargs):
     if config is None:
         config = kwargs
         kwargs = {}
-    if isinstance(model, str):
-        from ..module_inject.load_checkpoint import load_hf_checkpoint
+    is_torch_model = hasattr(model, "state_dict") and hasattr(getattr(model, "config", None), "to_dict")
+    if isinstance(model, str) or is_torch_model:
+        from ..module_inject.load_checkpoint import load_hf_checkpoint, load_hf_model
 
         dtype_str = (config.get("dtype") if isinstance(config, dict) else
                      getattr(config, "dtype", None)) or "bf16"
         dtype = jnp.bfloat16 if str(dtype_str) in ("bf16", "bfloat16", "torch.bfloat16") else \
             (jnp.float16 if str(dtype_str) in ("fp16", "half", "float16") else jnp.float32)
         mesh = kwargs.get("mesh")
-        model, params = load_hf_checkpoint(model, dtype=dtype, mesh=mesh, shard=mesh is not None)
+        loader = load_hf_checkpoint if isinstance(model, str) else load_hf_model
+        model, params = loader(model, dtype=dtype, mesh=mesh, shard=mesh is not None)
         kwargs.setdefault("params", params)
     return InferenceEngine(model, config=config, **kwargs)

@@ -1,6 +1,6 @@
 from .auto_tp import AutoTP, get_tp_rules
-from .load_checkpoint import (config_from_hf, convert_hf_state_dict, load_hf_checkpoint, load_hf_state_dict,
+from .load_checkpoint import (config_from_hf, convert_hf_state_dict, load_hf_checkpoint, load_hf_model, load_hf_state_dict,
                               shard_params, tp_shardings)
 
-__all__ = ["AutoTP", "get_tp_rules", "config_from_hf", "convert_hf_state_dict", "load_hf_checkpoint",
+__all__ = ["AutoTP", "get_tp_rules", "config_from_hf", "convert_hf_state_dict", "load_hf_checkpoint", "load_hf_model",
            "load_hf_state_dict", "shard_params", "tp_shardings"]

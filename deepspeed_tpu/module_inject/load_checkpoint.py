@@ -1116,12 +1116,32 @@ def load_hf_checkpoint(model_dir: str, dtype=None, mesh=None, shard: bool = Fals
     """
     with open(os.path.join(model_dir, "config.json")) as f:
         hf_cfg = json.load(f)
+    # validate the architecture BEFORE the (potentially multi-GB) weight read
     cfg = config_from_hf(hf_cfg, dtype=dtype, **config_overrides)
     sd = load_hf_state_dict(model_dir)
+    return _materialize_hf(hf_cfg, sd, cfg=cfg, dtype=dtype, mesh=mesh, shard=shard, origin=model_dir,
+                           **config_overrides)
+
+
+def load_hf_model(hf_model, dtype=None, mesh=None, shard: bool = False,
+                  **config_overrides) -> Tuple[CausalLM, Dict]:
+    """Convert a LIVE HF torch model object into ``(CausalLM, params)`` —
+    the reference's primary ``deepspeed.init_inference(model=hf_model)``
+    usage (``inference/engine.py:39``), without a save/load round-trip."""
+    hf_cfg = hf_model.config.to_dict()
+    sd = {k: _torch_to_numpy(v) for k, v in hf_model.state_dict().items()}
+    return _materialize_hf(hf_cfg, sd, dtype=dtype, mesh=mesh, shard=shard,
+                           origin=type(hf_model).__name__, **config_overrides)
+
+
+def _materialize_hf(hf_cfg: Dict, sd: Dict[str, np.ndarray], cfg=None, dtype=None, mesh=None,
+                    shard: bool = False, origin: str = "?", **config_overrides) -> Tuple[CausalLM, Dict]:
+    if cfg is None:
+        cfg = config_from_hf(hf_cfg, dtype=dtype, **config_overrides)
     params = convert_hf_state_dict(sd, cfg, hf_cfg.get("model_type", ""))
     model = CausalLM(cfg)
     n_params = sum(int(np.prod(v.shape)) for v in _flat_leaves(params))
-    logger.info(f"load_hf_checkpoint: {hf_cfg.get('model_type')} {n_params / 1e6:.1f}M params from {model_dir}")
+    logger.info(f"load_hf_checkpoint: {hf_cfg.get('model_type')} {n_params / 1e6:.1f}M params from {origin}")
     if shard:
         params = shard_params(params, model, mesh=mesh)
     return model, params

@@ -876,6 +876,12 @@ class DeepSpeedEngine:
         from .checkpoint_engine import _to_host
 
         self._check_no_pending_fused("save_16bit_model")
+        if self.config.zero_config.stage == 3 and not self.zero_gather_16bit_weights_on_model_save():
+            # reference engine.py:3565: consolidation is expensive and isn't
+            # a default — refuse rather than save a bogus partial model
+            log_dist(f"Did not save the model {os.path.join(save_dir, save_filename)} because "
+                     "`stage3_gather_16bit_weights_on_model_save` is False", ranks=[0])
+            return False
         # every process participates in the gather (non-addressable ZeRO-3
         # shards allgather across hosts); only process 0 writes the file
         host_tree = _to_host(self.params)
@@ -919,10 +925,6 @@ class DeepSpeedEngine:
             # own file: plain-python state, no array template needed on load
             self.checkpoint_engine.save(self.curriculum_scheduler.get_state(),
                                         os.path.join(d, CURRICULUM_STATE_FILENAME))
-        if self.zero_gather_16bit_weights_on_model_save():
-            # reference engine.py:3049 -> _save_zero_checkpoint + gathered
-            # 16-bit model export when stage3_gather_16bit... is set
-            self.save_16bit_model(d)
         if client_state:
             self.checkpoint_engine.save(client_state, os.path.join(d, CLIENT_STATE_FILENAME))
         if save_latest and jax.process_index() == 0:
@@ -940,12 +942,10 @@ class DeepSpeedEngine:
             # keeping this method's contract: (path, client_state) return,
             # warn-and-fresh-start on a missing 'latest', fused-pending
             # handling identical to the regular route
-            from ..checkpoint.universal import LATEST_FILENAME as UNI_LATEST
-
             if load_module_only or not load_lr_scheduler_states:
                 raise NotImplementedError("universal checkpoints restore the full training state; "
                                           "module-only / no-scheduler loads need the native layout")
-            if tag is None and not os.path.exists(os.path.join(load_dir, UNI_LATEST)):
+            if tag is None and not os.path.exists(os.path.join(load_dir, LATEST_FILENAME)):
                 logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
                 return None, {}
             if self._fused_pending is not None:
@@ -959,6 +959,7 @@ class DeepSpeedEngine:
                          ranks=[0])
             path = self.load_universal_checkpoint(load_dir, tag=tag,
                                                   load_optimizer_states=load_optimizer_states)
+            self._post_load_derived_state()
             return path, {}
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILENAME)
@@ -1022,10 +1023,7 @@ class DeepSpeedEngine:
                     self._accum_base = self.micro_steps
                 self.global_samples = int(state["global_samples"])
                 self.skipped_steps = int(state["skipped_steps"])
-                if self.progressive_layer_drop is not None:
-                    # theta is a pure function of the step — re-derive it or
-                    # the first resumed step trains with theta=1 (no drop)
-                    self.progressive_layer_drop.update_state(self.global_steps)
+                self._post_load_derived_state()
             curriculum_path = os.path.join(d, CURRICULUM_STATE_FILENAME)
             if self.curriculum_scheduler is not None and os.path.exists(curriculum_path):
                 self.curriculum_scheduler.set_state(self.checkpoint_engine.load(curriculum_path))
@@ -1042,6 +1040,18 @@ class DeepSpeedEngine:
             else:
                 self.compression_engine.scheduler.training_steps = self.global_steps
         return d, client_state
+
+    def _post_load_derived_state(self):
+        """Step-derived state shared by BOTH load routes: PLD theta and the
+        compression schedule are pure functions of the restored step (or the
+        first resumed step trains with theta=1 / un-annealed schedules), and
+        the accumulation clock must never sit ahead of micro_steps."""
+        if self.progressive_layer_drop is not None:
+            self.progressive_layer_drop.update_state(self.global_steps)
+        if self.compression_engine is not None:
+            self.compression_engine.scheduler.training_steps = self.global_steps
+        if self._accum_base > self.micro_steps:
+            self._accum_base = self.micro_steps
 
     def save_universal_checkpoint(self, save_dir: str, tag=None):
         """Write the degree-independent universal layout directly

@@ -242,7 +242,7 @@ def test_save_16bit_model(tmp_path):
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config={
         "train_micro_batch_size_per_gpu": 1,
         "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-        "zero_optimization": {"stage": 3},
+        "zero_optimization": {"stage": 3, "stage3_gather_16bit_weights_on_model_save": True},
         "mesh": {"data": 2, "fsdp": 4},
     })
     out = engine.save_16bit_model(str(tmp_path))
@@ -434,12 +434,17 @@ def test_stage3_gather_16bit_on_save_and_universal_load_knobs(tmp_path):
     }
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=init(), config=conf)
     assert engine.zero_gather_16bit_weights_on_model_save()
+    # stage-3 engine WITHOUT the flag refuses the consolidated export
+    nf = dict(conf); nf["zero_optimization"] = {"stage": 3}
+    e_noflag, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=init(), config=nf)
+    assert e_noflag.save_16bit_model(str(tmp_path / "refused")) is False
     batch = engine._put_batch({"input_ids": np.random.RandomState(0).randint(0, 64, (8, 16)).astype(np.int32)})
     loss = engine.forward(batch)
     engine.backward(loss)
     engine.step()
-    engine.save_checkpoint(str(tmp_path), tag="t1")
-    assert os.path.exists(os.path.join(str(tmp_path), "t1", "model.safetensors"))
+    # explicit export API (reference gating: stage 3 needs the flag)
+    out = engine.save_16bit_model(str(tmp_path / "export"))
+    assert out and os.path.exists(out)
 
     # universal save + config-routed universal load at a DIFFERENT mesh
     engine.save_universal_checkpoint(str(tmp_path / "uni"), tag="u1")

@@ -174,3 +174,15 @@ def test_mixtral_v1_forward_matches(tmp_path):
     eng = deepspeed_tpu.init_inference(model, config={"dtype": "fp32"}, params=params)
     logits = np.asarray(eng.forward(rng_ids.astype(np.int32)))
     np.testing.assert_allclose(logits, ref, rtol=3e-4, atol=3e-4)
+
+
+def test_init_inference_from_live_torch_model(tiny_gpt2_ckpt):
+    """The reference's PRIMARY entry: deepspeed.init_inference(model=<live
+    HF torch model>) — no save/load round-trip (inference/engine.py:39)."""
+    import deepspeed_tpu
+
+    d, ids, ref_logits = tiny_gpt2_ckpt
+    tm = transformers.GPT2LMHeadModel.from_pretrained(d).eval()
+    eng = deepspeed_tpu.init_inference(tm, config={"dtype": "fp32"})
+    got = np.asarray(eng.forward(ids))
+    np.testing.assert_allclose(got, ref_logits, rtol=3e-4, atol=3e-4)
