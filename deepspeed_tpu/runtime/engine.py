@@ -114,6 +114,11 @@ class DeepSpeedEngine:
         # --- parameters (fp32 master, sharded per plan) ---
         if model_parameters is None:
             raise ValueError("model_parameters (the parameter pytree, or an init fn taking a PRNG key) is required")
+        if callable(model_parameters) and not hasattr(model_parameters, "keys"):
+            # documented init-fn form, resolved HERE so every engine class
+            # (pipeline/hybrid subclasses included) honors it with the
+            # accelerator's configured seed
+            model_parameters = model_parameters(jax.random.PRNGKey(get_accelerator().initial_seed()))
         params_host = model_parameters
         tp_rules = model.partition_rules() if hasattr(model, "partition_rules") else []
         self._tp_rules = tp_rules
@@ -1076,7 +1081,7 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None, tra
     if model is None:
         raise ValueError("deepspeed_tpu.initialize: model is required")
     if model_parameters is None and hasattr(model, "init_params"):
-        model_parameters = model.init_params(jax.random.PRNGKey(0))
+        model_parameters = model.init_params(jax.random.PRNGKey(get_accelerator().initial_seed()))
 
     from .pipe.module import PipelineModule
 

@@ -465,3 +465,19 @@ def test_stage3_gather_16bit_on_save_and_universal_load_knobs(tmp_path):
     w1 = np.asarray(jax.device_get(jax.tree_util.tree_leaves(engine.params)[0]))
     w2 = np.asarray(jax.device_get(jax.tree_util.tree_leaves(e2.params)[0]))
     np.testing.assert_allclose(w1, w2, rtol=1e-6, atol=1e-6)
+
+
+def test_initialize_with_init_fn():
+    """model_parameters may be an init FN taking a PRNG key (the documented
+    alternative to passing the pytree)."""
+    cfg_model = CausalLM(gpt2_tiny())
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=cfg_model,
+        model_parameters=lambda key: cfg_model.init(key, {"input_ids": np.zeros((1, 16), np.int32)}),
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "adam", "params": {"lr": 1e-3}}, "mesh": {"data": 8}})
+    b = engine._put_batch({"input_ids": np.zeros((8, 16), np.int32)})
+    loss = engine.forward(b)
+    engine.backward(loss)
+    engine.step()
+    assert engine.was_step_applied()
