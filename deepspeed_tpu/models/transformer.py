@@ -535,61 +535,124 @@ class CausalLM:
         cfg = self.cfg
         if cfg.n_layers % num_stages != 0:
             raise ValueError(f"n_layers={cfg.n_layers} must divide evenly into {num_stages} pipeline stages")
-        if cfg.moe_num_experts > 0:
-            raise NotImplementedError("MoE + pipeline composition lands with expert-parallel pipeline support")
         if cfg.scan_layers:
             raise ValueError("disable scan_layers for pipeline (stages are stacked instead)")
-        if not cfg.uniform_window:
-            # stage_fn applies ONE Block(layer_idx=0) to every stacked layer;
-            # per-layer windows would silently take layer 0's window everywhere
-            raise NotImplementedError("per-layer window_layers models are not pipeline-partitionable "
-                                      "(stages share one block program)")
-        if cfg.embedding_norm:
-            raise NotImplementedError("embedding_norm (bloom) models are not pipeline-partitionable yet")
-        if cfg.norm == "layernorm_np":
-            raise NotImplementedError("layernorm_np (olmo) models are not pipeline-partitionable yet "
-                                      "(the head norm is keyed by param name)")
+        if cfg.mlm_head or cfg.type_vocab_size > 0:
+            raise NotImplementedError("BERT-style models (mlm_head / token-type embeddings) are not "
+                                      "pipeline-partitionable (the MLM head and segment embeddings are "
+                                      "not part of the pipelined embed/loss functions)")
         layers_per_stage = cfg.n_layers // num_stages
+
+        # Per-layer heterogeneity (MoE slots, sliding windows) pipelines by
+        # stacking: sub-layer j of every stage shares one block program, so
+        # the static per-layer metadata at global index s*lps+j must agree
+        # across stages s. MoE (every moe_layer_freq-th block, reference
+        # moe/layer.py:90 under pipe/module.py:86) aligns iff
+        # layers_per_stage % moe_layer_freq == 0.
+        if cfg.moe_num_experts > 0:
+            freq = max(1, cfg.moe_layer_freq)
+            if layers_per_stage % freq != 0:
+                raise ValueError(
+                    f"MoE x pipeline needs a stage-uniform expert pattern: layers_per_stage="
+                    f"{layers_per_stage} must be a multiple of moe_layer_freq={freq} "
+                    f"(pick num_stages so each stage holds whole MoE periods)")
+        # sliding windows align iff each sub-layer's window is identical
+        # across stages (gpt-neo's alternating global/local pattern aligns
+        # whenever layers_per_stage is even; qwen2 suffix windows only when
+        # the suffix starts on a stage boundary AND covers whole stages)
+        window_per_sub = []
+        for j in range(layers_per_stage):
+            ws = {cfg.window_for(s * layers_per_stage + j) for s in range(num_stages)}
+            if len(ws) > 1:
+                raise NotImplementedError(
+                    f"per-layer window pattern is not stage-uniform (sub-layer {j} sees windows {ws} "
+                    f"across stages); choose num_stages so the window pattern repeats per stage")
+            window_per_sub.append(ws.pop())
 
         if params is None:
             params = self.init(rng if rng is not None else jax.random.PRNGKey(0), example_batch)
+
+        # flax auto-names the module-level norms in creation order: the
+        # embedding norm (bloom) is created before the blocks, the final
+        # norm after them; layernorm_np (olmo) creates no params at all
+        auto_norm_keys = sorted((k for k in params if k.rsplit("_", 1)[0] in ("LayerNorm", "RMSNorm")),
+                                key=lambda k: int(k.rsplit("_", 1)[1]))
+        embed_norm_key = auto_norm_keys.pop(0) if (cfg.embedding_norm and auto_norm_keys) else None
+
         embed_params = {"wte": params["wte"]}
         if cfg.pos_emb == "learned":
             embed_params["wpe"] = params["wpe"]
+        if embed_norm_key is not None:
+            embed_params[embed_norm_key] = params[embed_norm_key]
         # stack block params: sub_j leaf -> (S, ...) over stages
         stages = {}
         for j in range(layers_per_stage):
             per_stage = [params[f"layer_{s * layers_per_stage + j}"] for s in range(num_stages)]
+            structs = {jax.tree_util.tree_structure(p) for p in per_stage}
+            if len(structs) > 1:
+                raise ValueError(f"sub-layer {j} has mismatched param structure across stages "
+                                 f"(per-layer heterogeneity must be stage-uniform): {structs}")
             stages[f"sub_{j}"] = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs, 0), *per_stage)
         head_params = {k: v for k, v in params.items()
-                       if not (k.startswith("layer_") or k in ("wte", "wpe"))}
+                       if not (k.startswith("layer_") or k in ("wte", "wpe") or k == embed_norm_key)}
         pipe_params = {"embed": embed_params, "stages": stages, "head": head_params}
 
-        block = Block(cfg, layer_idx=0)
+        # one block program per sub-layer: layer_idx=j reproduces the global
+        # MoE slot pattern (given the divisibility check above), and the
+        # stage-uniform window rides in via a per-sub-layer cfg
+        blocks = []
+        for j in range(layers_per_stage):
+            cfg_j = dataclasses.replace(cfg, sliding_window=window_per_sub[j], window_layers=None)
+            blocks.append(Block(cfg_j, layer_idx=j))
+        has_moe = cfg.moe_num_experts > 0
         norm_key = [k for k in head_params if "Norm" in k]
+        paramless_norm = cfg.norm == "layernorm_np"
 
         def embed_fn(ps, input_ids):
             ep = ps["embed"]
             B, S = input_ids.shape
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
             x = ep["wte"][input_ids].astype(cfg.dtype)
+            if cfg.embed_scale:  # gemma normalizer
+                x = x * jnp.asarray(cfg.d_model**0.5, cfg.dtype)
             if cfg.pos_emb == "learned":
                 x = x + ep["wpe"][positions].astype(cfg.dtype)
+            if cfg.embedding_norm:  # bloom word_embeddings_layernorm
+                if embed_norm_key is not None:
+                    x = make_norm(cfg).apply({"params": ep[embed_norm_key]}, x)
+                else:
+                    x = make_norm(cfg).apply({"params": {}}, x)
             return x
 
         def stage_fn(sp, x):
             B, S = x.shape[0], x.shape[1]
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+            aux = jnp.zeros((), jnp.float32)
             for j in range(layers_per_stage):
-                x = block.apply({"params": sp[f"sub_{j}"]}, x, positions)
+                if has_moe and blocks[j].is_moe:
+                    x, mods = blocks[j].apply({"params": sp[f"sub_{j}"]}, x, positions,
+                                              mutable=["losses", "intermediates"])
+                    leaves = jax.tree_util.tree_leaves(mods.get("losses", {}))
+                    aux = aux + sum(jnp.sum(l).astype(jnp.float32) for l in leaves)
+                else:
+                    x = blocks[j].apply({"params": sp[f"sub_{j}"]}, x, positions)
+            if has_moe:
+                # pre-scaled: the pipeline engine adds this straight into the
+                # loss (and seeds its cotangent with 1.0 on the bwd clock)
+                return x, aux * cfg.moe_aux_loss_coef
             return x
+
+        stage_fn.has_aux = has_moe
 
         def head_loss_fn(ps, x, labels_or_ids, labels_are_shifted: bool):
             from ..ops.fused_ce import fused_cross_entropy
 
             hp = ps["head"]
-            norm = make_norm(cfg)
-            x = norm.apply({"params": hp[norm_key[0]]}, x) if norm_key else x
+            if cfg.norm_scheme != "post":  # post-LN blocks already end normalized
+                if paramless_norm:  # olmo: final norm has no params
+                    x = make_norm(cfg).apply({"params": {}}, x)
+                elif norm_key:
+                    x = make_norm(cfg).apply({"params": hp[norm_key[0]]}, x)
             if labels_are_shifted:
                 labels = labels_or_ids
             else:

@@ -66,6 +66,18 @@ def _zeros_f32(tree):
     return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), tree)
 
 
+def _with_aux(stage_fn):
+    """Normalize a stage to the ``(y, aux_loss)`` contract (MoE stages set
+    ``stage_fn.has_aux`` and return a pre-scaled scalar aux loss)."""
+    if getattr(stage_fn, "has_aux", False):
+        return stage_fn
+
+    def call(sp, x):
+        return stage_fn(sp, x), jnp.zeros((), jnp.float32)
+
+    return call
+
+
 class PipelineEngine(DeepSpeedEngine):
     def __init__(self, args=None, model=None, optimizer=None, model_parameters=None, training_data=None,
                  lr_scheduler=None, mesh=None, mpu=None, dist_init_required=None, collate_fn=None, config=None,
@@ -148,6 +160,11 @@ class PipelineEngine(DeepSpeedEngine):
         D = max(1, min(2 * S - 1, M))  # stash ring depth (+1 garbage slot below)
         T = M + 2 * S - 2
         s_idx = jnp.arange(S)
+        # MoE stages emit (y, scaled_aux_loss); dense stages are wrapped to
+        # the same contract (XLA removes the dead zero) so one clock body
+        # serves both (reference: MoE aux loss rides the pipeline loss,
+        # moe/sharded_moe.py aux -> engine loss accumulation)
+        stage_call = _with_aux(stage_fn)
 
         def split_io(params):
             return {k: v for k, v in params.items() if k != "stages"}
@@ -170,8 +187,11 @@ class PipelineEngine(DeepSpeedEngine):
             loss_acc = jnp.zeros((), jnp.float32)
 
             def stage_vjp(p_s, x, g):
-                _, pull = jax.vjp(stage_fn, p_s, x)
-                gp, gx = pull(g)
+                _, pull = jax.vjp(stage_call, p_s, x)
+                # aux cotangent is 1.0: the aux loss enters the total loss
+                # unweighted (already coef-scaled inside the stage); invalid
+                # clocks' contributions are masked by bwd_valid downstream
+                gp, gx = pull((g, jnp.ones((), jnp.float32)))
                 return gx, gp
 
             def clock(carry, k):
@@ -190,8 +210,10 @@ class PipelineEngine(DeepSpeedEngine):
                 slots = jnp.where(fwd_valid, jnp.mod(mf, D), D)
                 stash = jax.vmap(lambda st, slot, xi: jax.lax.dynamic_update_index_in_dim(st, xi, slot, axis=0))(
                     stash, slots, x_in)
-                y = jax.vmap(stage_fn)(params["stages"], x_in)
+                y, aux_vec = jax.vmap(stage_call)(params["stages"], x_in)
                 y = jax.lax.with_sharding_constraint(y, pspec)
+                # MoE aux loss: each stage contributes once per valid forward
+                loss_acc = loss_acc + jnp.sum(jnp.where(fwd_valid, aux_vec, 0.0))
 
                 # ---- head: loss + seed grad (last stage's 1F1B pair) ----
                 # The unembed+CE vjp is matmul-heavy (~25% of fwd FLOPs at
@@ -291,8 +313,10 @@ class PipelineEngine(DeepSpeedEngine):
                 x_embed = embed_fn(ps_io, jax.lax.dynamic_index_in_dim(
                     ids, jnp.clip(k, 0, M - 1), axis=0, keepdims=False))
                 x_in = jax.lax.dynamic_update_index_in_dim(buf, x_embed.astype(buf.dtype), 0, axis=0)
-                y = jax.vmap(stage_fn)(params["stages"], x_in)
+                y, aux_vec = jax.vmap(stage_call)(params["stages"], x_in)
                 y = jax.lax.with_sharding_constraint(y, pspec)
+                fwd_valid = (k - s_idx >= 0) & (k - s_idx < M)
+                loss_acc = loss_acc + jnp.sum(jnp.where(fwd_valid, aux_vec, 0.0))
                 mb_last = k - (S - 1)
                 head_valid = (mb_last >= 0) & (mb_last < M)
                 mb_last_c = jnp.clip(mb_last, 0, M - 1)
@@ -338,7 +362,9 @@ class PipelineEngine(DeepSpeedEngine):
         batch_axes = topo.batch_axes
         baxis = batch_axes if len(batch_axes) > 1 else batch_axes[0]
         mesh = topo.mesh
-        stage_f = jax.checkpoint(stage_fn) if remat else stage_fn
+        stage_call = _with_aux(stage_fn)
+        stage_f = jax.checkpoint(stage_call) if remat else stage_call
+        s_idx = jnp.arange(S)
 
         def loss_fn(params, batch, rng=None):
             ids = batch["input_ids"]  # (M, G, seq)
@@ -353,15 +379,19 @@ class PipelineEngine(DeepSpeedEngine):
             buf = jnp.zeros((S, G, seq, d), x_all.dtype)
             buf = jax.lax.with_sharding_constraint(buf, NamedSharding(mesh, P("pipe", baxis)))
             outputs = jnp.zeros((M, G, seq, d), x_all.dtype)
+            aux_acc = jnp.zeros((), jnp.float32)
 
             def clock(carry, t):
-                buf, outputs = carry
+                buf, outputs, aux_acc = carry
                 inject = jax.lax.dynamic_index_in_dim(x_all, jnp.minimum(t, M - 1), axis=0, keepdims=False)
                 inject = jnp.where(t < M, inject, jnp.zeros_like(inject))
                 buf = jax.lax.dynamic_update_index_in_dim(buf, inject.astype(buf.dtype), 0, axis=0)
                 buf = jax.lax.with_sharding_constraint(buf, NamedSharding(mesh, P("pipe", baxis)))
-                y = jax.vmap(lambda sp, xb: stage_f(sp, xb))(params["stages"], buf)
+                y, aux_vec = jax.vmap(lambda sp, xb: stage_f(sp, xb))(params["stages"], buf)
                 y = jax.lax.with_sharding_constraint(y, NamedSharding(mesh, P("pipe", baxis)))
+                # stage s holds microbatch t-s this clock; mask the bubbles
+                valid = (t - s_idx >= 0) & (t - s_idx < M)
+                aux_acc = aux_acc + jnp.sum(jnp.where(valid, aux_vec, 0.0))
                 out_t = y[S - 1]
                 idx = jnp.maximum(t - (S - 1), 0)
                 updated = jax.lax.dynamic_update_index_in_dim(outputs, out_t.astype(outputs.dtype), idx, axis=0)
@@ -369,15 +399,15 @@ class PipelineEngine(DeepSpeedEngine):
                 # roll: stage s+1 receives stage s's output next clock
                 # (CollectivePermute over ICI = Send/RecvActivation)
                 buf = jnp.roll(y, 1, axis=0)
-                return (buf, outputs), None
+                return (buf, outputs, aux_acc), None
 
-            (buf, outputs), _ = jax.lax.scan(clock, (buf, outputs), jnp.arange(M + S - 1))
+            (buf, outputs, aux_acc), _ = jax.lax.scan(clock, (buf, outputs, aux_acc), jnp.arange(M + S - 1))
 
             if labels is not None:
                 losses = jax.vmap(lambda o, l: head_loss_fn(ps_io, o, l, True))(outputs, labels)
             else:
                 losses = jax.vmap(lambda o, i: head_loss_fn(ps_io, o, i, False))(outputs, ids)
-            return jnp.mean(losses)
+            return jnp.mean(losses) + aux_acc / M
 
         return loss_fn
 
