@@ -74,10 +74,10 @@ class InferenceEngine:
             # params live int8+scales in HBM (capacity ~halved at rest);
             # each jitted step dequantizes inside the graph. The v2 ragged
             # engine's quant_bits path additionally keeps int8 through the
-            # matmuls via the fused dequant-matmul kernel.
-            if tp > 1:
-                raise NotImplementedError("weight-only quant + tensor-parallel v1 serving is not wired; "
-                                          "serve quantized at tp=1 (or use the v2 engine)")
+            # matmuls via the fused dequant-matmul kernel. Under TP this
+            # quantizes the already-sharded tree (the reference's order,
+            # replace_module.py:43); the flat-layout dequant is plain XLA,
+            # so GSPMD partitions it per the codes' shardings.
             from .quantization import quantize_model_params
 
             qc = self._config.quant

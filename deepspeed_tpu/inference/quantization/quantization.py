@@ -77,7 +77,7 @@ def dequantize_param(qp: QuantizedParam) -> jnp.ndarray:
     if qp.layout.startswith("kgroups"):
         from ...ops.pallas.quantized_matmul import _dequantize_kgroups
 
-        wf = _dequantize_kgroups(qp.q, qp.scales, packed=qp.layout == "kgroups_p4")
+        wf = _dequantize_kgroups(qp.q, qp.scales, packed=qp.layout.startswith("kgroups_p4"))
         return wf.reshape(qp.shape).astype(qp.dtype)
     from ...ops.pallas.quantization import dequantize_groupwise_xla
 
@@ -103,12 +103,51 @@ def _matmul_2d_form(path_key: str, shape: Tuple[int, ...]) -> Optional[Tuple[int
     return None
 
 
+def _shard_info(w, path_key: str, ndim: int) -> Tuple[int, bool]:
+    """(K-shard count, leaf-is-sharded) from a leaf's committed sharding.
+
+    Supports quantize-AFTER-sharding (the reference order: ``GroupQuantizer``
+    quantizes post-mp-shard, ``module_inject/replace_module.py:43``): K-group
+    boundaries must align with the shard split so every shard's scales are
+    computed from (and stored with) its own rows only.
+    """
+    sharding = getattr(w, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if spec is None or mesh is None:
+        return 1, False
+    spec = tuple(spec) + (None,) * (ndim - len(tuple(spec)))
+    # contraction dims of the 2D matmul form (see _matmul_2d_form)
+    kdims = (0, 1) if (ndim == 3 and path_key == "o_proj") else (0,)
+
+    def axis_size(names) -> int:
+        if names is None:
+            return 1
+        names = names if isinstance(names, tuple) else (names,)
+        size = 1
+        for n in names:
+            size *= dict(mesh.shape)[n]
+        return size
+
+    kshards = 1
+    for d in kdims:
+        kshards *= axis_size(spec[d])
+    return kshards, any(spec[d] is not None for d in range(ndim))
+
+
 def quantize_for_serving(params, num_bits: int = 8, group_size: int = 128, min_size: int = 4096):
     """Quantize matmul ``kernel`` weights into the fused-kernel ("kgroups")
     layout for the v2 serving engine: attention projections, MLP linears
     and the untied lm_head. Embeddings (gather consumers), norms, biases
     and MoE expert stacks stay dense.
+
+    TP-sharded leaves (quantize-after-sharding, the reference's order —
+    ``module_inject/replace_module.py:43`` quantizes post-mp-shard) get
+    K-groups aligned to the shard split so scales stay shard-local, and a
+    ``+gspmd`` layout marker routing the matmul through the partitionable
+    dequant path (the Pallas kernel is a custom call GSPMD cannot split).
     """
+    from ...ops.pallas._utils import block_that_divides
     from ...ops.pallas.quantized_matmul import quantize_weight_kgroups
 
     n_q = [0]
@@ -123,12 +162,15 @@ def quantize_for_serving(params, num_bits: int = 8, group_size: int = 128, min_s
         if form is None:
             return w
         K, N = form
-        q, scales = quantize_weight_kgroups(jnp.asarray(w).reshape(K, N), group_size=group_size,
+        kshards, is_sharded = _shard_info(w, keys[-2], len(w.shape))
+        gs = group_size if kshards == 1 else block_that_divides(K // kshards, group_size)
+        q, scales = quantize_weight_kgroups(jnp.asarray(w).reshape(K, N), group_size=gs,
                                             bits=num_bits, pack=num_bits == 4)
         pack = q.shape[0] != K  # the quantizer degrades to unpacked when the group size is odd
+        layout = ("kgroups_p4" if pack else "kgroups") + ("+gspmd" if is_sharded else "")
         n_q[0] += 1
         return QuantizedParam(q=q, scales=scales, shape=tuple(w.shape), dtype=jnp.asarray(w).dtype,
-                              num_bits=num_bits, layout="kgroups_p4" if pack else "kgroups")
+                              num_bits=num_bits, layout=layout)
 
     out = jax.tree_util.tree_map_with_path(leaf, params)
     logger.info(f"quantize_for_serving: {n_q[0]} matmul weights -> int{num_bits} "

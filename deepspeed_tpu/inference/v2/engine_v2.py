@@ -138,15 +138,6 @@ class InferenceEngineV2:
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.tree_util.tree_map(cast, params)
-        if config.quant_bits:
-            if self._tp > 1:
-                raise NotImplementedError("weight-only quant + tensor-parallel serving: quantize after "
-                                          "sharding is not wired yet — serve quantized at tp=1")
-            from ..quantization import quantize_for_serving
-
-            self.params = quantize_for_serving(self.params, num_bits=config.quant_bits,
-                                               group_size=config.quant_group_size,
-                                               min_size=config.quant_min_size)
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -156,6 +147,15 @@ class InferenceEngineV2:
             page_sharding = NamedSharding(self._mesh_topo.mesh, P(None, None, None, "tensor", None))
             self.k_pages = jax.device_put(self.k_pages, page_sharding)
             self.v_pages = jax.device_put(self.v_pages, page_sharding)
+        if config.quant_bits:
+            # quantize AFTER sharding (the reference's order, GroupQuantizer
+            # post-mp-shard in module_inject/replace_module.py:43): K-groups
+            # align to the shard split so every shard's scales are local
+            from ..quantization import quantize_for_serving
+
+            self.params = quantize_for_serving(self.params, num_bits=config.quant_bits,
+                                               group_size=config.quant_group_size,
+                                               min_size=config.quant_min_size)
         interpret = config.interpret_kernels
         if interpret is None:
             from ...ops.registry import pallas_available

@@ -26,6 +26,7 @@ Module contracts (all pure functions over the flax param pytree):
 - unembed(cfg, params, x, last_token_idx) -> (B, V) fp32 logits
 """
 
+import functools
 from typing import Any, Callable, Dict, NamedTuple
 
 import jax
@@ -52,10 +53,14 @@ def _norm_p(cfg: TransformerConfig, container, idx: int):
 def _qproj(x, qp, dtype):
     """Apply a kgroups-quantized kernel through the fused dequant-matmul
     (ref mixed-GEMM): flatten x's trailing dims to the contraction size,
-    restore the kernel's output dims after."""
+    restore the kernel's output dims after. TP-sharded leaves (``+gspmd``
+    layout) go through the ``custom_partitioning`` wrapper: each shard
+    runs the fused kernel on its own rows/columns and row-parallel
+    partials psum over the K axis — a bare Pallas custom call under jit
+    would instead force a full all-gather of the codes."""
     from ...ops.registry import REGISTRY as _R
 
-    packed = qp.layout == "kgroups_p4"
+    packed = qp.layout.startswith("kgroups_p4")
     K = qp.q.shape[0] * (2 if packed else 1)
     t, i = 1, x.ndim
     while t < K:
@@ -66,7 +71,13 @@ def _qproj(x, qp, dtype):
     while t < K:
         t *= qp.shape[j]
         j += 1
-    out2 = _R.get("quantized_matmul")(x.reshape(-1, K).astype(dtype), qp.q, qp.scales, packed=packed)
+    if qp.layout.endswith("+gspmd"):
+        from ...ops.pallas.quantized_matmul import quantized_matmul_sharded
+
+        mm = functools.partial(quantized_matmul_sharded, packed=packed)
+    else:
+        mm = functools.partial(_R.get("quantized_matmul"), packed=packed)
+    out2 = mm(x.reshape(-1, K).astype(dtype), qp.q, qp.scales)
     return out2.reshape(x.shape[:i] + tuple(qp.shape[j:])).astype(dtype)
 
 

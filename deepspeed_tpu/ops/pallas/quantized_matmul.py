@@ -179,3 +179,76 @@ def _qmm_pallas(x, q, scales, packed: bool = False, **kw):
 def quantized_matmul(x, q, scales, **kw):
     """Registry-dispatched entry (Pallas on TPU, XLA elsewhere)."""
     return REGISTRY.get("quantized_matmul")(x, q, scales, **kw)
+
+
+# ----------------------------------------------------------------------
+# TP-sharded serving: GSPMD-partitionable wrapper
+# ----------------------------------------------------------------------
+def _spec_of(arg_info, ndim):
+    spec = tuple(getattr(arg_info.sharding, "spec", ()) or ())
+    return spec + (None,) * (ndim - len(spec))
+
+
+_QMM_SHARDED = {}
+
+
+def quantized_matmul_sharded(x, q, scales, *, packed: bool = False):
+    """``quantized_matmul`` for TP-sharded codes (quantize-after-sharding).
+
+    A Pallas kernel is a custom call GSPMD cannot split, so a plain call
+    under jit would all-gather every operand. ``custom_partitioning``
+    teaches the partitioner the matmul's algebra instead:
+
+    - codes sharded on N (column-parallel q/k/v/up/gate/lm_head): every
+      shard runs the fused kernel on its own columns; output N-sharded.
+    - codes sharded on K (row-parallel o_proj/down_proj): x arrives
+      K-sharded from the previous op, each shard contracts its rows
+      through the fused kernel, and the partial products ``psum`` over
+      the K mesh axes — the standard row-parallel allreduce, with the
+      weight never leaving its int8 shard.
+
+    Group alignment (``quantize_for_serving``) guarantees scales split on
+    the same boundaries as the codes.
+    """
+    key = bool(packed)
+    if key not in _QMM_SHARDED:
+        _QMM_SHARDED[key] = _build_qmm_sharded(key)
+    return _QMM_SHARDED[key](x, q, scales)
+
+
+def _build_qmm_sharded(packed: bool):
+    from jax.experimental.custom_partitioning import custom_partitioning
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    @custom_partitioning
+    def qmm(x, q, scales):
+        return REGISTRY.get("quantized_matmul")(x, q, scales, packed=packed)
+
+    def infer(mesh, arg_infos, out_shape):
+        xs = _spec_of(arg_infos[0], 2)
+        qs = _spec_of(arg_infos[1], 2)
+        return NamedSharding(mesh, P(xs[0], qs[1]))
+
+    def partition(mesh, arg_infos, out_shape):
+        xs = _spec_of(arg_infos[0], 2)
+        qs = _spec_of(arg_infos[1], 2)
+        m_ax, k_ax, n_ax = xs[0], qs[0], qs[1]
+        arg_shardings = (NamedSharding(mesh, P(m_ax, k_ax)),
+                         NamedSharding(mesh, P(k_ax, n_ax)),
+                         NamedSharding(mesh, P(k_ax, n_ax)))
+        out_sharding = NamedSharding(mesh, P(m_ax, n_ax))
+
+        def lower_fn(x, q, scales):
+            y = REGISTRY.get("quantized_matmul")(x, q, scales, packed=packed)
+            if k_ax is not None:  # row-parallel: reduce the K partials
+                y = jax.lax.psum(y, k_ax)
+            return y
+
+        return mesh, lower_fn, out_sharding, arg_shardings
+
+    # einsum-like rule for Shardy propagation; k/j/g intentionally distinct
+    # factors (packed int4 codes have K/2 rows; scales have K/g) — the
+    # partition callback, not the rule, aligns the contraction shardings
+    qmm.def_partition(infer_sharding_from_operands=infer, partition=partition,
+                      sharding_rule="m k, j n, g n -> m n")
+    return qmm
