@@ -35,6 +35,19 @@ def _ops():
             g = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, **kw)
                                  .astype(jnp.float32).sum()))(q, k, v)
             float(g.astype(jnp.float32).sum())
+        # GQA-native path (collapsed KV + revisit-accumulated dkv grid):
+        # full grads, parity vs the XLA oracle on-chip
+        from deepspeed_tpu.ops.attention import attention_xla
+
+        kg, vg = (jax.random.normal(kk, (B, S, 2, D), jnp.bfloat16) for kk in ks[:2])
+        for kw in ({}, {"alibi_slopes": slopes}, {"window": 128}):
+            gf = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, **kw)
+                                  .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
+            gx = jax.jit(jax.grad(lambda q, k, v: attention_xla(q, k, v, causal=True, **kw)
+                                  .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
+            for a, b in zip(gf, gx):
+                d = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
+                assert d < 0.1, f"flash GQA grad mismatch {kw}: {d}"
 
     def sparse():
         from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig, FixedSparsityConfig, sparse_attention
