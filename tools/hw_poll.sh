@@ -1,0 +1,19 @@
+#!/bin/bash
+# Poll the TPU tunnel; the moment it's alive, run the full one-shot
+# hardware session (tools/hw_session.sh). Writes a status line per poll
+# to hw_poll.status so a foreground session can see progress at a glance.
+cd "$(dirname "$0")/.." || exit 1
+STATUS=hw_poll.status
+while true; do
+    echo "[poll $(date +%H:%M:%S)] checking tunnel" >> "$STATUS"
+    if timeout 110 python -c "
+import jax, jax.numpy as jnp
+print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$STATUS" 2>&1; then
+        echo "[poll $(date +%H:%M:%S)] TUNNEL ALIVE - starting hw_session" >> "$STATUS"
+        bash tools/hw_session.sh hw_session_r4.log
+        echo "[poll $(date +%H:%M:%S)] hw_session finished rc=$?" >> "$STATUS"
+        exit 0
+    fi
+    echo "[poll $(date +%H:%M:%S)] dead, sleeping 600s" >> "$STATUS"
+    sleep 600
+done

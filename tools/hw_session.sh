@@ -25,10 +25,14 @@ note "2/3 bench.py full ladder (zero2 + zero3/decode/serve/attn/longctx extras -
 timeout 3600 python bench.py >> "$LOG" 2>&1
 note "bench rc=$?"
 
-note "3/3 int8 weight-only A/B (decode + serve rungs)"
+note "3/4 int8 weight-only A/B (decode + serve rungs)"
 DS_BENCH_QUANT=1 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
 note "quant decode rc=$?"
 DS_BENCH_QUANT=1 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
 note "quant serve rc=$?"
 
-note "session complete - artifacts: BENCH_extra.json + $LOG"
+note "4/4 train flag/block sweep (TRAIN_SWEEP.jsonl)"
+bash tools/train_sweep.sh >> "$LOG" 2>&1
+note "train sweep rc=$?"
+
+note "session complete - artifacts: BENCH_extra.json + TRAIN_SWEEP.jsonl + $LOG"

@@ -1,0 +1,39 @@
+#!/bin/bash
+# XLA-flag x micro-batch sweep for the zero2 train rung (VERDICT r4 item 5:
+# push train past parity). Each combo runs in its own process (XLA flags are
+# process-wide); results append to TRAIN_SWEEP.jsonl as they land so a
+# tunnel death mid-sweep keeps the finished rows.
+cd "$(dirname "$0")/.." || exit 1
+OUT=TRAIN_SWEEP.jsonl
+: > "$OUT"
+
+note() { echo "[train_sweep $(date +%H:%M:%S)] $*" >&2; }
+
+run_one() {
+    local label="$1" flags="$2"
+    note "combo: $label"
+    local line
+    line=$(XLA_FLAGS="${XLA_FLAGS:-} $flags" DS_BENCH_EXTRA=0 DS_BENCH_RUNG=zero2 \
+           timeout 1500 python bench.py 2>/dev/null | tail -1)
+    if [ -n "$line" ]; then
+        echo "{\"combo\": \"$label\", \"result\": $line}" >> "$OUT"
+        note "  -> $line"
+    else
+        echo "{\"combo\": \"$label\", \"result\": null}" >> "$OUT"
+        note "  -> FAILED/empty"
+    fi
+}
+
+# 1) current default (anchor; r3 measured 115.1k tok/s/chip)
+run_one "default" ""
+# 2) latency-hiding scheduler: overlaps host transfers + inter-fusion gaps
+run_one "lhs" "--xla_tpu_enable_latency_hiding_scheduler=true"
+# 3) larger scoped VMEM: lets XLA form bigger fusions before spilling
+run_one "vmem64m" "--xla_tpu_scoped_vmem_limit_kib=65536"
+# 4) both
+run_one "lhs+vmem64m" "--xla_tpu_enable_latency_hiding_scheduler=true --xla_tpu_scoped_vmem_limit_kib=65536"
+# 5) flash block ladder at the winning flags (r3 sweep said 512x512; re-check
+#    under lhs since the scheduler changes the fusion boundaries)
+DS_TPU_FLASH_BQ=1024 DS_TPU_FLASH_BK=1024 run_one "lhs+blk1024" "--xla_tpu_enable_latency_hiding_scheduler=true"
+
+note "sweep complete -> $OUT"
