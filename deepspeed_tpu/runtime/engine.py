@@ -372,6 +372,24 @@ class DeepSpeedEngine:
 
         self._accumulate = jax.jit(accumulate, donate_argnums=(0,), out_shardings=self.grad_shardings)
 
+        # grad-accumulation dtype (reference data_types.grad_accum_dtype,
+        # config.py:898): bf16 halves the accumulator's HBM footprint and
+        # add bandwidth across the gas window; the optimizer math still
+        # runs fp32 (apply_updates upcasts). Default fp32.
+        _acc_names = {None: jnp.float32, "fp32": jnp.float32, "float32": jnp.float32,
+                      "bf16": jnp.bfloat16, "bfloat16": jnp.bfloat16,
+                      "fp16": jnp.float16, "float16": jnp.float16, "half": jnp.float16}
+        acc_name = self.config.gradient_accumulation_dtype
+        if acc_name not in _acc_names:
+            raise ValueError(f"data_types.grad_accum_dtype must be one of "
+                             f"{sorted(k for k in _acc_names if k)}, got {acc_name!r}")
+        self._grad_acc_dtype = _acc_names[acc_name]
+        self._to_acc_dtype = None
+        if self._grad_acc_dtype != jnp.float32:
+            self._to_acc_dtype = jax.jit(
+                lambda g: jax.tree_util.tree_map(lambda x: x.astype(self._grad_acc_dtype), g),
+                out_shardings=self.grad_shardings)
+
         clip = self.config.gradient_clipping
         opt = self.optimizer
 
@@ -434,11 +452,6 @@ class DeepSpeedEngine:
             return loss_fn(params_c, batch, rng)
 
         self._eval_loss = jax.jit(eval_loss)
-
-        def zeros_like_sharded(params32):
-            return jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, jnp.float32), params32)
-
-        self._zero_grads = jax.jit(zeros_like_sharded, out_shardings=self.grad_shardings)
 
         if self._param_offload == "eager":
             # engine-level swap: async device_put of the host store before
@@ -559,7 +572,8 @@ class DeepSpeedEngine:
         if self._cached_grads is _FUSED:
             pass  # grads were consumed inside the fused forward dispatch
         elif self._grad_acc is None:
-            self._grad_acc = self._cached_grads
+            self._grad_acc = self._cached_grads if self._to_acc_dtype is None \
+                else self._to_acc_dtype(self._cached_grads)
         else:
             self._grad_acc = self._accumulate(self._grad_acc, self._cached_grads)
         self._cached_grads = None

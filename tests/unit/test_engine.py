@@ -50,6 +50,36 @@ def _train(engine, steps=4, seed=0, n=64):
     return losses
 
 
+def test_bf16_grad_accumulation():
+    """data_types.grad_accum_dtype=bf16 (reference config.py:898): the
+    accumulator holds bf16, optimizer math stays fp32, and the loss
+    trajectory tracks the fp32-accumulation default."""
+    e32 = _make_engine(stage=2)
+    e16 = _make_engine(stage=2, extra={"data_types": {"grad_accum_dtype": "bf16"}})
+    assert e16._grad_acc_dtype == jnp.bfloat16
+
+    rng = np.random.RandomState(0)
+    l32, l16 = [], []
+    for engine, out in ((e32, l32), (e16, l16)):
+        g = engine.train_micro_batch_size_per_gpu * engine.topology.data_parallel_size
+        rng = np.random.RandomState(0)
+        for _ in range(3):
+            for _ in range(2):  # gas=2
+                batch = engine._put_batch({"input_ids": rng.randint(0, 1024, (g, 16)).astype(np.int32)})
+                loss = engine.forward(batch)
+                engine.backward(loss)
+                acc_leaf = jax.tree_util.tree_leaves(engine._grad_acc)[0]
+                assert acc_leaf.dtype == engine._grad_acc_dtype
+            engine.step()
+            out.append(float(loss))
+    np.testing.assert_allclose(l32, l16, rtol=0.05, atol=1e-3)
+
+
+def test_grad_accum_dtype_rejects_unknown():
+    with pytest.raises(ValueError, match="grad_accum_dtype"):
+        _make_engine(stage=0, extra={"data_types": {"grad_accum_dtype": "int8"}})
+
+
 def test_stage0_loss_decreases():
     engine = _make_engine(stage=0)
     # 16 samples == exactly one optimizer step's data => repeats each step
