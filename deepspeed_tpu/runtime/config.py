@@ -389,6 +389,10 @@ class DeepSpeedConfig:
         self.fused_step = _fs
         self.prescale_gradients = bool(d.get("prescale_gradients", False))
         self.gradient_predivide_factor = float(d.get("gradient_predivide_factor", 1.0))
+        # accepted-but-moot (PARITY.md "Sparse gradients"): the embedding
+        # vjp is a dense scatter-add fused into the compiled step and DP
+        # reduction is a GSPMD psum/reduce-scatter; there is no separate
+        # allreduce for a sparse path to shortcut
         self.sparse_gradients_enabled = bool(d.get("sparse_gradients", False))
         self.steps_per_print = int(d.get("steps_per_print", 10))
         self.wall_clock_breakdown = bool(d.get("wall_clock_breakdown", False))

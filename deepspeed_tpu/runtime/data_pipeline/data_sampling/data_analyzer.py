@@ -22,6 +22,21 @@ def _shard_bounds(n: int, num_workers: int, worker_id: int):
     return worker_id * per, min((worker_id + 1) * per, n)
 
 
+def _jax_runtime_live() -> bool:
+    """True when jax has initialized a backend in this process (its thread
+    pools make fork() deadlock-prone; map shards run sequentially then)."""
+    import sys
+
+    if "jax" not in sys.modules:
+        return False
+    try:
+        from jax._src import xla_bridge
+
+        return xla_bridge.backends_are_initialized()
+    except Exception:
+        return True  # can't tell: assume live, stay safe
+
+
 class DataAnalyzer:
 
     def __init__(self,
@@ -87,10 +102,44 @@ class DataAnalyzer:
             idx_builder.finalize()
 
     def run_map_reduce(self) -> None:
+        """One-call orchestration (reference ``data_analyzer.py`` fans the
+        map over its dataloader workers and reduces once): fork one process
+        per worker shard, then reduce in the caller. Runs the shards
+        sequentially in-process instead when forking would be unsafe (JAX
+        backends already initialized — a fork could snapshot a runtime
+        thread's lock mid-flight) or unavailable — same files, same
+        results, no pickling requirements either way."""
         if self.num_workers > 1:
-            # multi-worker runs call run_map per worker then reduce once
-            raise RuntimeError("run_map_reduce is single-worker; call run_map on each worker, then run_reduce")
-        self.run_map()
+            workers = [DataAnalyzer(self.dataset, str(self.save_path), self.metric_names, self.metric_functions,
+                                    metric_types=self.metric_types, num_workers=self.num_workers, worker_id=w,
+                                    batch_size=self.batch_size, metric_dtypes=self.metric_dtypes)
+                       for w in range(self.num_workers)]
+            ctx = None
+            if not _jax_runtime_live():
+                try:
+                    import multiprocessing as mp
+
+                    ctx = mp.get_context("fork")
+                except ValueError:  # platform without fork
+                    ctx = None
+            if ctx is not None:
+                procs = [ctx.Process(target=w.run_map) for w in workers]
+                for p in procs:
+                    p.start()
+                # join ALL workers before raising: an early raise would
+                # orphan live children still writing shard files (a retry
+                # would then race them on the same builder paths)
+                for p in procs:
+                    p.join()
+                failed = [w.worker_id for w, p in zip(workers, procs) if p.exitcode]
+                if failed:
+                    raise RuntimeError(f"data-analyzer map workers {failed} failed "
+                                       f"(see their tracebacks above)")
+            else:
+                for w in workers:
+                    w.run_map()
+        else:
+            self.run_map()
         self.run_reduce()
 
     @staticmethod

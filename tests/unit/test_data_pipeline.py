@@ -91,10 +91,17 @@ def test_random_ltd_min_value_clamp():
     assert sched.update_seq(0) == 100
 
 
-def test_analyzer_map_reduce_rejects_multiworker(tmp_path):
-    an = DataAnalyzer([1, 2], str(tmp_path), ["m"], [lambda b: b], num_workers=2, worker_id=1)
-    with pytest.raises(RuntimeError):
-        an.run_map_reduce()
+def test_analyzer_map_reduce_multiworker_one_call(tmp_path):
+    """run_map_reduce fans the map over a process pool (reference single-call
+    orchestration) and produces the same files as the manual per-worker flow."""
+    dataset = [{"input_ids": np.arange(n)} for n in [5, 3, 9, 1, 7, 2, 8, 4]]
+
+    def seqlen_metric(batch):
+        return [len(s["input_ids"]) for s in batch]
+
+    an = DataAnalyzer(dataset, str(tmp_path), ["seqlen"], [seqlen_metric], num_workers=3, batch_size=2)
+    an.run_map_reduce()
+    np.testing.assert_array_equal(DataAnalyzer.load_metric(str(tmp_path), "seqlen"), [5, 3, 9, 1, 7, 2, 8, 4])
 
 
 def test_sampler_state_snapshot_is_immutable():
