@@ -107,17 +107,13 @@ class InferenceEngineV2:
             # window_for() applies no window anywhere, but the paged runner
             # reads sliding_window directly — normalize so they agree
             run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
-        if run_cfg.sliding_window is not None and run_cfg.sliding_window >= smc.max_context:
+        if (run_cfg.uniform_window and run_cfg.sliding_window is not None
+                and run_cfg.sliding_window >= smc.max_context):
             # the window can never mask inside this engine's context budget;
-            # dropping it keeps decode on the Pallas paged kernel
+            # dropping it keeps decode on the Pallas paged kernel (per-layer
+            # window models keep their pattern — the runner bakes one kernel
+            # variant per distinct per-layer window value)
             run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
-        if not run_cfg.uniform_window:
-            # the paged runner applies ONE window to every layer; serving a
-            # mixed global/local stack (gpt-neo) here would silently mask
-            # wrong — route such models through the v1 engine instead. Raised
-            # BEFORE the KV pools allocate (no throwaway device memory).
-            raise NotImplementedError("per-layer window_layers models are not servable by the ragged "
-                                      "v2 engine (one window per model); use the v1 engine")
         n_blocks = smc.num_kv_blocks
         if n_blocks is None:
             bytes_per_block = (2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * cfg.head_dim *

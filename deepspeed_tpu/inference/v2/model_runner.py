@@ -64,26 +64,32 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         # against its KV-page shard (ref v2 sharding helpers). Per-shard
         # slope slices aren't expressible as a baked constant, so ALiBi/
         # window models route through the gather path under TP.
-        decode_attn = shard_map(
+        tp_decode_attn = shard_map(
             functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale),
             mesh=mesh, in_specs=(P(None, "tensor", None), P(None, None, "tensor", None),
                                  P(None, None, "tensor", None), P(None, None), P(None)),
             out_specs=P(None, "tensor", None), check_vma=False)
-        decode_native = False
-        prefill_attn = None
+        attn_fns = lambda window: (tp_decode_attn, None, False)
     else:
-        decode_attn = functools.partial(
-            paged_attention_decode, interpret=interpret, scale=cfg.attn_scale,
-            alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
-            window=cfg.sliding_window)
-        # interpret mode (CPU dev serving) keeps the compute-bound prefill on
-        # the fused XLA gather path — emulating the page-walk kernel there is
-        # strictly slower; on real TPU the kernel avoids the context gather
-        prefill_attn = None if interpret else functools.partial(
-            paged_attention_prefill, scale=cfg.attn_scale,
-            alibi_slopes=alibi_slopes(H) if cfg.pos_emb == "alibi" else None,
-            window=cfg.sliding_window)
-        decode_native = True
+        # one (decode, prefill) pair per distinct per-layer window value
+        # (gpt-neo alternates global/local; qwen2 windows a layer suffix) —
+        # the layer loop is unrolled, so windows are static per layer and
+        # each value bakes its own kernel variant
+        _slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
+        _fns = {}
+
+        def attn_fns(window):
+            if window not in _fns:
+                decode = functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale,
+                                           alibi_slopes=_slopes, window=window)
+                # interpret mode (CPU dev serving) keeps the compute-bound
+                # prefill on the fused XLA gather path — emulating the
+                # page-walk kernel there is strictly slower; on real TPU the
+                # kernel avoids the context gather
+                prefill = None if interpret else functools.partial(
+                    paged_attention_prefill, scale=cfg.attn_scale, alibi_slopes=_slopes, window=window)
+                _fns[window] = (decode, prefill, True)
+            return _fns[window]
 
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids, positions)
@@ -114,9 +120,11 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         k_pages = k_pages.at[i].set(kp)
         v_pages = v_pages.at[i].set(vp)
 
+        w_i = cfg.window_for(i)
+        decode_attn, prefill_attn, decode_native = attn_fns(w_i)
         attn = mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
                               slopes=slopes, decode_attn=decode_attn, decode_native=decode_native,
-                              prefill_attn=prefill_attn)
+                              prefill_attn=prefill_attn, window=w_i)
         attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
 
         if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j

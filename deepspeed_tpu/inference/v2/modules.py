@@ -17,10 +17,13 @@ Module contracts (all pure functions over the flax param pytree):
 - norm(cfg, p, x) -> normed x        (pre_norm/post_norm collapse to one;
   p is None iff cfg.norm == "layernorm_np" — param-free olmo norms)
 - attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, *, decode,
-  slopes, decode_attn, decode_native, prefill_attn) -> (B, S, H, D)
+  slopes, decode_attn, decode_native, prefill_attn, window) -> (B, S, H, D)
   (``decode_native``: decode_attn/prefill_attn already bake ALiBi/window;
-  implementations MUST accept ``**kwargs`` so future call-site arguments
-  don't break registered alternates)
+  ``window`` is THIS layer's sliding window — per-layer-window models pass
+  a different value per layer, so an alternate that reads
+  ``cfg.sliding_window`` instead of ``window`` will silently mis-mask
+  gpt-neo-class stacks; implementations MUST accept ``**kwargs`` so future
+  call-site arguments don't break registered alternates)
 - mlp(cfg, p, x) -> (B, S, d)
 - moe(cfg, p, x) -> (B, S, d)        (no-drop ragged dispatch)
 - unembed(cfg, params, x, last_token_idx) -> (B, V) fp32 logits
@@ -124,21 +127,28 @@ def norm_tpu(cfg: TransformerConfig, p, x):
     return REGISTRY.get("rms_norm")(x, w, cfg.norm_eps).astype(cfg.dtype)
 
 
+_CFG_WINDOW = object()  # sentinel: caller did not pass a per-layer window
+
+
 def attention_tpu(cfg: TransformerConfig, q, kp, vp, block_tables, ctx_lens, positions, *, decode: bool,
                   slopes=None, decode_attn: Callable = None, decode_native: bool = False,
-                  prefill_attn: Callable = None, **_):
+                  prefill_attn: Callable = None, window=_CFG_WINDOW, **_):
     """ref ``implementations/attention/dense_blocked_attention.py``: Pallas
     paged kernels on both hot paths — decode and chunked prefill, incl.
     ALiBi/window baked in-kernel when ``decode_native`` — gather-based
-    reference attention for bias-carrying models under TP sharding."""
-    plain = slopes is None and cfg.sliding_window is None
+    reference attention for bias-carrying models under TP sharding.
+    ``window``: THIS layer's sliding window (per-layer models pass each
+    layer's own value; default = the model-wide ``cfg.sliding_window``)."""
+    if window is _CFG_WINDOW:
+        window = cfg.sliding_window
+    plain = slopes is None and window is None
     native = plain or decode_native
     if decode and decode_attn is not None and native:
         return decode_attn(q[:, 0], kp, vp, block_tables, ctx_lens)[:, None]
     if not decode and prefill_attn is not None and native:
         return prefill_attn(q, kp, vp, block_tables, ctx_lens, positions)
     return paged_attention_ref(q, kp, vp, block_tables, ctx_lens, positions, scale=cfg.attn_scale,
-                               alibi_slopes=slopes, window=cfg.sliding_window)
+                               alibi_slopes=slopes, window=window)
 
 
 def mlp_tpu(cfg: TransformerConfig, p: Dict[str, Any], x):
