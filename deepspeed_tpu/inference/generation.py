@@ -101,7 +101,11 @@ def _build_fused_decode(model, max_new_tokens: int, do_sample: bool, temperature
         if eos_token_id is not None:
             last = jnp.where(finished, eos_token_id, last)
         tokens = jnp.concatenate([tokens.T, last[:, None]], axis=1) if max_new_tokens > 1 else last[:, None]
-        return tokens
+        # caches are returned ONLY to give every donated input an alias
+        # target (the caller drops them): without this XLA warns "Some
+        # donated buffers were not usable" and the in-loop cache updates
+        # cannot reuse the donated pages in place
+        return tokens, caches
 
     return jax.jit(fused, donate_argnums=(2,))
 
@@ -130,7 +134,7 @@ def generate_tokens(model, params, prefill_fn, decode_fn, input_ids, *, max_new_
         if fn is None:
             fn = per_model[key] = _build_fused_decode(model, max_new_tokens, do_sample, temperature,
                                                       top_k, top_p, eos_token_id)
-        tokens = fn(params, logits, caches, rng)
+        tokens, _ = fn(params, logits, caches, rng)
         return jnp.concatenate([input_ids, tokens], axis=1)
 
     out = [input_ids]

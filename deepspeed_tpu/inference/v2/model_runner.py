@@ -32,7 +32,7 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-from ...models.transformer import TransformerConfig, alibi_slopes, apply_rope, rope_frequencies
+from ...models.transformer import (TransformerConfig, alibi_slopes, apply_rope, scaled_rope_frequencies)
 from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_prefill, update_kv_pages)
 from ...ops.registry import REGISTRY
 from .modules import _norm_p, _proj, build_modules
@@ -95,7 +95,7 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     x = mods.embedding(cfg, params, input_ids, positions)
     cos = sin = None
     if cfg.pos_emb == "rope":
-        cos, sin = rope_frequencies(cfg.rotary_dim, cfg.max_seq_len, cfg.rope_theta)
+        cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
     # slopes feed the gather-based attention used for prefill and for the
     # TP-sharded decode; the single-chip decode kernel has them baked in
     # (decode_native above)
@@ -107,6 +107,8 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
         q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
         k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
         v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
+        if cfg.clip_qkv is not None:  # olmo: clamp projections before rope
+            q, k, v = (jnp.clip(t, -cfg.clip_qkv, cfg.clip_qkv) for t in (q, k, v))
         if cfg.qk_norm:  # qwen3: per-head rms before rope
             rms = REGISTRY.get("rms_norm")
             q = rms(q, lp["attn"]["q_norm"]["scale"], cfg.norm_eps).astype(dtype)

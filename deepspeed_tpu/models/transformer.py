@@ -42,6 +42,18 @@ class TransformerConfig:
     rotary_pct: float = 1.0  # fraction of head_dim rotated (gpt-neox/phi partial rotary)
     rotary_dims: Optional[int] = None  # exact rotated dim count (gpt-j rotary_dim); overrides rotary_pct
     rope_style: str = "neox"  # neox (rotate-half) | gptj (interleaved pairs)
+    # HF rope_scaling variants (transformers modeling_rope_utils.py):
+    # linear (position interpolation), dynamic (NTK-by-parts at max_seq_len),
+    # llama3 (frequency-banded interpolation — llama-3.1+), yarn
+    rope_scaling: Optional[str] = None  # linear | dynamic | llama3 | yarn
+    rope_factor: float = 1.0
+    rope_orig_max_seq: Optional[int] = None  # original_max_position_embeddings
+    rope_low_freq_factor: float = 1.0   # llama3
+    rope_high_freq_factor: float = 4.0  # llama3
+    rope_beta_fast: float = 32.0        # yarn extrapolation boundary
+    rope_beta_slow: float = 1.0         # yarn interpolation boundary
+    rope_attn_factor: Optional[float] = None  # yarn cos/sin scale; None = 0.1*ln(factor)+1
+    clip_qkv: Optional[float] = None  # olmo: clamp q/k/v activations to [-c, c]
     # block wiring: sequential (gpt2/llama), parallel (gpt-neox: two norms,
     # x + attn(ln1 x) + mlp(ln2 x)), parallel_shared (falcon-7b/phi/gpt-j:
     # one norm feeds both attn and mlp)
@@ -193,6 +205,63 @@ def rope_frequencies(head_dim: int, max_len: int, theta: float) -> Tuple[jnp.nda
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
+def scaled_rope_frequencies(cfg: "TransformerConfig", head_dim: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables honoring ``cfg.rope_scaling`` with HF semantics
+    (``transformers/modeling_rope_utils.py`` — the parity oracle the
+    interop tests check against). Precomputed with numpy: frequencies are
+    static per config, and fp64 intermediate math avoids compounding the
+    pow/log chain in fp32."""
+    rd, theta, factor = head_dim, cfg.rope_theta, cfg.rope_factor
+    inv = 1.0 / (theta**(np.arange(0, rd, 2, dtype=np.float64) / rd))
+    attn_factor = 1.0
+    kind = cfg.rope_scaling
+    if kind == "linear":
+        inv = inv / factor
+    elif kind == "dynamic":
+        # NTK-aware base rescale at the engine's static max context (HF
+        # recomputes per growing seq_len; compiled tables take the worst
+        # case, which equals HF exactly while serving <= rope_orig_max_seq
+        # and bounds it above)
+        orig = cfg.rope_orig_max_seq or cfg.max_seq_len
+        seq_len = max(cfg.max_seq_len, orig)
+        base = theta * ((factor * seq_len / orig) - (factor - 1))**(rd / (rd - 2))
+        inv = 1.0 / (base**(np.arange(0, rd, 2, dtype=np.float64) / rd))
+    elif kind == "llama3":
+        orig = cfg.rope_orig_max_seq or cfg.max_seq_len
+        low_wav = orig / cfg.rope_low_freq_factor
+        high_wav = orig / cfg.rope_high_freq_factor
+        wavelen = 2 * np.pi / inv
+        inv_l = np.where(wavelen > low_wav, inv / factor, inv)
+        smooth = (orig / wavelen - cfg.rope_low_freq_factor) / \
+            (cfg.rope_high_freq_factor - cfg.rope_low_freq_factor)
+        smoothed = (1 - smooth) * inv_l / factor + smooth * inv_l
+        medium = ~(wavelen < high_wav) & ~(wavelen > low_wav)
+        inv = np.where(medium, smoothed, inv_l)
+    elif kind == "yarn":
+        orig = cfg.rope_orig_max_seq or cfg.max_seq_len
+
+        def corr_dim(n_rot):
+            return (rd * np.log(orig / (n_rot * 2 * np.pi))) / (2 * np.log(theta))
+
+        low = max(np.floor(corr_dim(cfg.rope_beta_fast)), 0)
+        high = min(np.ceil(corr_dim(cfg.rope_beta_slow)), rd - 1)
+        if low == high:
+            high += 0.001  # HF's singularity guard
+        ramp = np.clip((np.arange(rd // 2, dtype=np.float64) - low) / (high - low), 0, 1)
+        extrap_factor = 1 - ramp
+        inv = (inv / factor) * (1 - extrap_factor) + inv * extrap_factor
+        if cfg.rope_attn_factor is not None:
+            attn_factor = cfg.rope_attn_factor
+        else:
+            attn_factor = 0.1 * np.log(factor) + 1.0 if factor > 1 else 1.0
+    elif kind is not None:
+        raise NotImplementedError(f"rope_scaling={kind!r} (supported: linear/dynamic/llama3/yarn)")
+    t = np.arange(cfg.max_seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)  # (L, rd/2)
+    return (jnp.asarray(np.cos(freqs) * attn_factor, jnp.float32),
+            jnp.asarray(np.sin(freqs) * attn_factor, jnp.float32))
+
+
 def apply_rope(x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray, positions: jnp.ndarray,
                rotary_dim: Optional[int] = None, style: str = "neox") -> jnp.ndarray:
     """x: (B,S,H,D); positions: (B,S) absolute token positions.
@@ -251,13 +320,16 @@ class Attention(nn.Module):
         q = dense((H, D), "q_proj")(x)
         k = dense((KVH, D), "k_proj")(x)
         v = dense((KVH, D), "v_proj")(x)
+        if cfg.clip_qkv is not None:  # olmo: clamp projections before rope
+            c = cfg.clip_qkv
+            q, k, v = (jnp.clip(t, -c, c) for t in (q, k, v))
         if cfg.qk_norm:  # qwen3: head-dim RMSNorm before rope
             q = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="q_norm")(q)
             k = RMSNorm(eps=cfg.norm_eps, dtype=cfg.dtype, name="k_norm")(k)
 
         if cfg.pos_emb == "rope":
             rd = cfg.rotary_dim
-            cos, sin = rope_frequencies(rd, cfg.max_seq_len, cfg.rope_theta)
+            cos, sin = scaled_rope_frequencies(cfg, rd)
             q = apply_rope(q, cos, sin, positions, rotary_dim=rd, style=cfg.rope_style)
             k = apply_rope(k, cos, sin, positions, rotary_dim=rd, style=cfg.rope_style)
 

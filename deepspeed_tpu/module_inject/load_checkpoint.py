@@ -101,31 +101,50 @@ def _map_gelu(hf_act: str) -> str:
     return "gelu"
 
 
-def _reject_rope_scaling(hf: Dict[str, Any]) -> None:
-    """Refuse checkpoints whose rope needs scaling we don't implement.
+def _rope_scaling_kwargs(hf: Dict[str, Any]) -> Dict[str, Any]:
+    """HF ``rope_scaling`` → TransformerConfig rope_* kwargs.
 
-    HF ``rope_scaling`` (llama3, qwen yarn, phi3 longrope, linear/dynamic
-    NTK) changes the rotary frequencies; loading such a checkpoint with the
-    base rope would produce logits that silently diverge beyond the base
-    context window.  A trivial entry (``type``/``rope_type`` of ``default``
-    with ``factor`` 1) is a no-op and is allowed through.
+    Supported variants (``scaled_rope_frequencies`` implements the HF
+    semantics, oracle-tested): linear, dynamic NTK, llama3 (llama-3.1+
+    frequency-banded interpolation), yarn. ``longrope`` (phi-3 long
+    contexts, per-dim factor tables) is still refused — loading it with
+    base rope would silently diverge past the base window.
     """
     rs = hf.get("rope_scaling") or hf.get("rope_parameters")
     if not isinstance(rs, dict):
-        return
+        return {}
     kind = rs.get("rope_type", rs.get("type", "default"))
     factor = rs.get("factor", 1.0)
-    if factor is None or float(factor) == 1.0:
-        # identity scaling: default always; linear/dynamic interpolate by
-        # `factor` alone, so factor==1 leaves every frequency unchanged
-        # (yarn/llama3/longrope carry extra parameters — still rejected)
-        if kind in (None, "default", "linear", "dynamic"):
-            return
-    raise NotImplementedError(
-        f"HF config requests rope_scaling={rs!r} ({hf.get('model_type', '?')}); "
-        "scaled-rope variants (linear/dynamic/yarn/llama3/longrope) are not "
-        "implemented — logits would silently diverge past the base context"
-    )
+    if kind in (None, "default"):
+        return {}
+    if factor is None or (float(factor) == 1.0 and kind in ("linear", "dynamic")):
+        return {}  # identity interpolation
+    if kind not in ("linear", "dynamic", "llama3", "yarn"):
+        raise NotImplementedError(
+            f"HF config requests rope_scaling={rs!r} ({hf.get('model_type', '?')}); supported "
+            "variants: linear/dynamic/llama3/yarn — longrope-class per-dim tables are not, and "
+            "loading with base rope would silently diverge past the base context")
+    kw: Dict[str, Any] = {"rope_scaling": kind, "rope_factor": float(factor)}
+    if kind == "dynamic":
+        # HF _compute_dynamic_ntk_parameters rescales against
+        # max_position_embeddings (its original_max_position_embeddings is
+        # unused for dynamic), so at the checkpoint's own context the table
+        # is the base rope; scaling kicks in only when max_seq_len is
+        # overridden past it
+        orig = hf.get("max_position_embeddings")
+    else:
+        orig = rs.get("original_max_position_embeddings")
+    if orig:
+        kw["rope_orig_max_seq"] = int(orig)
+    if kind == "llama3":
+        kw["rope_low_freq_factor"] = float(rs.get("low_freq_factor", 1.0))
+        kw["rope_high_freq_factor"] = float(rs.get("high_freq_factor", 4.0))
+    if kind == "yarn":
+        kw["rope_beta_fast"] = float(rs.get("beta_fast") or 32.0)
+        kw["rope_beta_slow"] = float(rs.get("beta_slow") or 1.0)
+        if rs.get("attention_factor") is not None:
+            kw["rope_attn_factor"] = float(rs["attention_factor"])
+    return kw
 
 
 def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerConfig:
@@ -134,7 +153,7 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
 
     model_type = hf.get("model_type", "")
     dtype = dtype if dtype is not None else jnp.float32
-    _reject_rope_scaling(hf)
+    rope_kw = _rope_scaling_kwargs(hf)
     if model_type == "gpt2":
         kw = dict(
             vocab_size=hf["vocab_size"],
@@ -203,9 +222,8 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                 moe_aux_loss_coef=hf.get("router_aux_loss_coef", 0.02),
             )
     elif model_type == "olmo":
-        if hf.get("clip_qkv"):
-            raise NotImplementedError("olmo clip_qkv (qkv activation clipping) unsupported")
         kw = dict(
+            clip_qkv=float(hf["clip_qkv"]) if hf.get("clip_qkv") else None,
             vocab_size=hf["vocab_size"],
             n_layers=hf.get("num_hidden_layers", 2),
             n_heads=hf.get("num_attention_heads", 4),
@@ -516,6 +534,10 @@ def config_from_hf(hf: Dict[str, Any], dtype=None, **overrides) -> TransformerCo
                                   "mistral, qwen2, qwen3, mixtral, internlm, opt, gpt_neox, gptj, gpt_neo, "
                                   "falcon, phi, phi3, bloom, gpt_bigcode, gemma, stablelm, olmo, bert, "
                                   "distilbert)")
+    if kw.get("pos_emb") == "rope":
+        kw.update(rope_kw)
+    elif rope_kw:
+        raise NotImplementedError(f"rope_scaling on a non-rope architecture {model_type!r}")
     kw.update(overrides)
     return TransformerConfig(**kw)
 

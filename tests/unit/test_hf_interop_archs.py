@@ -346,6 +346,50 @@ def test_new_arch_tp2_serving(tmp_path, arch):
     np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
 
 
+@pytest.mark.parametrize("scaling", [
+    {"rope_type": "linear", "factor": 2.0},
+    {"rope_type": "dynamic", "factor": 2.0},
+    # dynamic's original_max_position_embeddings is UNUSED in HF (the
+    # rescale denominator is max_position_embeddings) — parity must hold
+    # even when a checkpoint carries it
+    {"rope_type": "dynamic", "factor": 2.0, "original_max_position_embeddings": 32},
+    {"rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+     "original_max_position_embeddings": 32},
+    {"rope_type": "yarn", "factor": 2.0, "original_max_position_embeddings": 32},
+])
+def test_llama_rope_scaling_logits_match(tmp_path, scaling):
+    """HF rope_scaling variants (linear / dynamic NTK / llama-3.1 banded /
+    yarn) load and match the torch oracle exactly — previously refused
+    (scaled_rope_frequencies implements modeling_rope_utils semantics)."""
+    cfg = transformers.LlamaConfig(vocab_size=128, hidden_size=64, intermediate_size=128,
+                                   num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                                   max_position_embeddings=64, rope_scaling=dict(scaling))
+    torch.manual_seed(23)
+    model, _ = _roundtrip(tmp_path, transformers.LlamaForCausalLM(cfg), IDS)
+    assert model.cfg.rope_scaling == scaling["rope_type"]
+    assert model.cfg.rope_factor == scaling["factor"]
+
+
+def test_longrope_still_rejected(tmp_path):
+    from deepspeed_tpu.module_inject.load_checkpoint import config_from_hf
+
+    with pytest.raises(NotImplementedError, match="longrope"):
+        config_from_hf({"model_type": "llama", "vocab_size": 64, "hidden_size": 32,
+                        "num_hidden_layers": 2, "num_attention_heads": 2,
+                        "rope_scaling": {"rope_type": "longrope", "factor": 4.0,
+                                         "short_factor": [1.0], "long_factor": [2.0]}})
+
+
+def test_olmo_clip_qkv_logits_match(tmp_path):
+    """OLMo clip_qkv (qkv activation clamping) — previously refused."""
+    cfg = transformers.OlmoConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+                                  num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+                                  tie_word_embeddings=False, clip_qkv=0.05)
+    torch.manual_seed(91)
+    model, _ = _roundtrip(tmp_path, transformers.OlmoForCausalLM(cfg), IDS)
+    assert model.cfg.clip_qkv == 0.05
+
+
 def test_olmo_logits_match(tmp_path):
     """OLMo: llama layout with non-parametric layernorms."""
     cfg = transformers.OlmoConfig(vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,

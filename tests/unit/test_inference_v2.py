@@ -418,6 +418,21 @@ class TestPrefillKernel:
 
 
 # ------------------------------------------------------------------ weight-only quant serving
+def test_rope_scaling_serving():
+    """llama-3.1-style banded rope scaling through the ragged engine: the
+    paged runner's frequency tables must match the dense model's."""
+    cfg = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2, d_model=32, max_seq_len=64,
+                            norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False,
+                            rope_scaling="llama3", rope_factor=8.0, rope_orig_max_seq=32)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(4), {"input_ids": np.zeros((1, 8), np.int32)})
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64, num_kv_blocks=32),
+        dtype="float32"))
+    prompt = [3, 17, 42, 9, 88, 5]
+    assert eng.generate([prompt], max_new_tokens=6)[0] == _dense_generate(model, params, prompt, 6)
+
+
 def test_per_layer_window_serving():
     """gpt-neo-style alternating global/local windows through the ragged v2
     engine: the runner bakes one attention variant per distinct per-layer
