@@ -196,15 +196,33 @@ class InferenceEngineV2:
         """
         if len(batch_uids) != len(batch_tokens):
             raise ValueError("uids and token lists must align")
+        if len(set(batch_uids)) != len(batch_uids):
+            # two chunks of one sequence in a single step would read the same
+            # start position and overwrite each other's KV slots — the
+            # scheduler never emits this; refuse instead of corrupting
+            raise ValueError("duplicate uid in one put() batch: submit a sequence's chunks "
+                             "in separate steps")
         logits_by_idx: Dict[int, np.ndarray] = {}
 
         decode_idx: List[int] = []
+        prefill_groups: Dict[int, List[int]] = {}  # padded length bucket -> indices
         for i, (uid, toks) in enumerate(zip(batch_uids, batch_tokens)):
             seq = self.state.get_sequence(uid)
             if seq is not None and len(toks) == 1:
                 decode_idx.append(i)
             else:
-                logits_by_idx[i] = self._run_prefill(uid, list(toks), return_tokens=return_tokens)
+                prefill_groups.setdefault(max(16, _next_pow2(len(toks))), []).append(i)
+
+        # prefills sharing a length bucket run as ONE batched dispatch (the
+        # reference's ragged batch mixes all prefills into one forward;
+        # here same-bucket grouping keeps shapes static). The scheduler
+        # hands out uniform prefill chunks, so admission phases coalesce.
+        for S, idxs in prefill_groups.items():
+            rows = self._run_prefill_batch([batch_uids[i] for i in idxs],
+                                           [list(batch_tokens[i]) for i in idxs], S,
+                                           return_tokens=return_tokens)
+            for i, row in zip(idxs, rows):
+                logits_by_idx[i] = row
 
         if decode_idx:
             uids = [batch_uids[i] for i in decode_idx]
@@ -228,34 +246,61 @@ class InferenceEngineV2:
         # round-robin within the garbage page so padded writes stay cheap
         return (self._garbage_block * self.state.block_size + np.arange(n) % self.state.block_size).astype(np.int32)
 
-    def _run_prefill(self, uid: int, tokens: List[int], return_tokens: bool = False) -> np.ndarray:
-        """Prefill one sequence chunk (possibly with prior context)."""
-        seq = self.state.get_or_create_sequence(uid)
-        self.state.allocate_for(seq, len(tokens))
-        seq.pre_forward(len(tokens))
+    def _run_prefill_batch(self, uids: List[int], token_lists: List[List[int]], S: int,
+                           return_tokens: bool = False) -> List[np.ndarray]:
+        """Prefill a bucket of sequence chunks (each possibly with prior
+        context) in one dispatch; the batch dim pads to a power of two so
+        the compile ladder stays logarithmic. Padded rows write their KV
+        to the garbage page and their outputs are dropped."""
+        n = len(uids)
+        B = _next_pow2(n)
         bs = self.state.block_size
-        start, n = seq.seen_tokens, len(tokens)
-        S = max(16, _next_pow2(n))
-
-        ids = np.zeros((1, S), np.int32)
-        ids[0, :n] = tokens
-        positions = np.zeros((1, S), np.int32)
-        positions[0, :n] = np.arange(start, start + n)
-        slots = self._garbage_slots(S)
-        for t in range(n):
-            pos = start + t
-            slots[t] = seq.blocks[pos // bs] * bs + pos % bs
-        ctx = np.array([start + n], np.int32)
-        bt = self._seq_block_row(seq)[None]
-        last = np.array([n - 1], np.int32)
+        # validate the WHOLE bucket before mutating any sequence: a mid-loop
+        # allocation failure would otherwise leave earlier sequences with
+        # in-flight tokens and allocated blocks whose forward never ran
+        total_need = 0
+        for uid, tokens in zip(uids, token_lists):
+            seq = self.state.get_or_create_sequence(uid)
+            total = seq.seen_tokens + seq.in_flight_tokens + len(tokens)
+            if total > self.state.max_context:
+                raise RuntimeError(f"sequence {uid}: {total} tokens exceeds max_context "
+                                   f"{self.state.max_context}")
+            total_need += seq.blocks_needed(len(tokens))
+        if not self.state.can_allocate(total_need):
+            raise RuntimeError(f"prefill bucket needs {total_need} KV blocks, "
+                               f"{self.state.free_blocks} free")
+        ids = np.zeros((B, S), np.int32)
+        positions = np.zeros((B, S), np.int32)
+        slots = np.tile(self._garbage_slots(S), B).reshape(B, S)
+        ctx = np.ones((B,), np.int32)
+        bt = np.full((B, self._max_blocks_per_seq), self._garbage_block, np.int32)
+        last = np.zeros((B,), np.int32)
+        seqs = []
+        for j, (uid, tokens) in enumerate(zip(uids, token_lists)):
+            seq = self.state.get_or_create_sequence(uid)
+            self.state.allocate_for(seq, len(tokens))
+            seq.pre_forward(len(tokens))
+            start, m = seq.seen_tokens, len(tokens)
+            ids[j, :m] = tokens
+            positions[j, :m] = np.arange(start, start + m)
+            pos = start + np.arange(m)
+            slots[j, :m] = np.asarray(seq.blocks, np.int32)[pos // bs] * bs + pos % bs
+            ctx[j] = start + m
+            bt[j] = self._seq_block_row(seq)
+            last[j] = m - 1
+            seqs.append(seq)
 
         logits, self.k_pages, self.v_pages = self._prefill_fn(self.params, jnp.asarray(ids), jnp.asarray(positions),
                                                               self.k_pages, self.v_pages, jnp.asarray(bt),
-                                                              jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last))
-        seq.post_forward()
+                                                              jnp.asarray(ctx), jnp.asarray(slots.reshape(-1)),
+                                                              jnp.asarray(last))
+        for seq in seqs:
+            seq.post_forward()
         if return_tokens:
-            return np.asarray(jnp.argmax(logits[0], axis=-1))  # device argmax, tiny readback
-        return np.asarray(logits[0])
+            out = np.asarray(jnp.argmax(logits[:n], axis=-1))  # device argmax, tiny readback
+        else:
+            out = np.asarray(logits[:n])
+        return [out[j] for j in range(n)]
 
     def _assemble_decode(self, uids: List[int], tokens: List[int], steps: int):
         """Shared decode-batch assembly for single steps and bursts.

@@ -43,6 +43,10 @@ class DSStateManager:
         return self._allocator.free_blocks
 
     @property
+    def max_context(self) -> int:
+        return self._config.max_context
+
+    @property
     def total_blocks(self) -> int:
         return self._allocator.total_blocks
 
