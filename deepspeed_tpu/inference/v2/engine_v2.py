@@ -158,10 +158,44 @@ class InferenceEngineV2:
             interpret = not pallas_available()
         run_mesh = self._mesh_topo.mesh if self._mesh_topo is not None else None
         self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp)
-        self._burst_fn = make_burst_fn(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp) \
-            if config.decode_burst >= 2 else None
+        self._run_cfg, self._interpret, self._run_mesh = run_cfg, interpret, run_mesh
+        self._bursts: Dict[tuple, object] = {}  # sampling signature -> jitted burst
+        self._sampling = None  # (do_sample, temperature, top_k, top_p) during generate()
+        self._rng = jax.random.PRNGKey(0)
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
                  f"({n_blocks * bs} cached tokens), dtype={config.dtype}", ranks=[0])
+
+    _MAX_BURST_VARIANTS = 8
+
+    def _burst_for(self, sampling):
+        """Cached jitted burst per sampling signature (greedy = None).
+
+        The cache is bounded: sampling params are user floats, so a
+        frontend forwarding per-request temperatures would otherwise grow
+        compiled burst programs without limit — oldest signature evicted
+        (its executables free with the jit wrapper)."""
+        if self._config.decode_burst < 2:
+            return None
+        key = sampling or (False, 1.0, 0, 1.0)
+        if key not in self._bursts:
+            if len(self._bursts) >= self._MAX_BURST_VARIANTS:
+                self._bursts.pop(next(iter(self._bursts)))
+            do, t, k, p = key
+            self._bursts[key] = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
+                                              tp=self._tp, do_sample=do, temperature=t, top_k=k, top_p=p)
+        return self._bursts[key]
+
+    def _choose_tokens(self, logits) -> np.ndarray:
+        """Device-side token choice for (n, V) logits: argmax, or the shared
+        sampler during a sampling generate() — either way only n ints cross
+        the host boundary."""
+        if self._sampling is None:
+            return np.asarray(jnp.argmax(logits, axis=-1))
+        from ..generation import sample_logits
+
+        do, t, k, p = self._sampling
+        self._rng, r = jax.random.split(self._rng)
+        return np.asarray(sample_logits(logits, r, do, t, k, p))
 
     # ---------------------------------------------------------- feasibility
     def query(self, uid: int, max_request_length: int) -> Tuple[int, int]:
@@ -255,17 +289,19 @@ class InferenceEngineV2:
         n = len(uids)
         B = _next_pow2(n)
         bs = self.state.block_size
-        # validate the WHOLE bucket before mutating any sequence: a mid-loop
+        # validate the WHOLE bucket before mutating any state: a mid-loop
         # allocation failure would otherwise leave earlier sequences with
-        # in-flight tokens and allocated blocks whose forward never ran
+        # in-flight tokens and allocated blocks whose forward never ran —
+        # and the validation itself must not register new uids in the
+        # tracker (a rejected request would leak its descriptor slot)
         total_need = 0
         for uid, tokens in zip(uids, token_lists):
-            seq = self.state.get_or_create_sequence(uid)
-            total = seq.seen_tokens + seq.in_flight_tokens + len(tokens)
-            if total > self.state.max_context:
-                raise RuntimeError(f"sequence {uid}: {total} tokens exceeds max_context "
+            seq = self.state.get_sequence(uid)
+            seen = (seq.seen_tokens + seq.in_flight_tokens) if seq is not None else 0
+            if seen + len(tokens) > self.state.max_context:
+                raise RuntimeError(f"sequence {uid}: {seen + len(tokens)} tokens exceeds max_context "
                                    f"{self.state.max_context}")
-            total_need += seq.blocks_needed(len(tokens))
+            total_need += seq.blocks_needed(len(tokens)) if seq is not None else -(-len(tokens) // bs)
         if not self.state.can_allocate(total_need):
             raise RuntimeError(f"prefill bucket needs {total_need} KV blocks, "
                                f"{self.state.free_blocks} free")
@@ -297,7 +333,7 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
         if return_tokens:
-            out = np.asarray(jnp.argmax(logits[:n], axis=-1))  # device argmax, tiny readback
+            out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         else:
             out = np.asarray(logits[:n])
         return [out[j] for j in range(n)]
@@ -343,7 +379,7 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
         if return_tokens:
-            return np.asarray(jnp.argmax(logits[:n], axis=-1))  # device argmax, tiny readback
+            return self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         return np.asarray(logits[:n])
 
     def _burst_steps(self, live: Dict[int, int], remaining: int) -> int:
@@ -352,7 +388,7 @@ class InferenceEngineV2:
         Powers of two keep the number of distinct (B, steps) compiles to a
         log ladder. 0 means burst is not worthwhile/feasible.
         """
-        if self._burst_fn is None or not live:
+        if self._config.decode_burst < 2 or not live:
             return 0
         cap = min(remaining, self._config.decode_burst,
                   *(self._config.state_manager.max_context - self.state.get_sequence(u).seen_tokens
@@ -370,22 +406,36 @@ class InferenceEngineV2:
     def _run_decode_burst(self, uids: List[int], tokens: List[int], steps: int) -> np.ndarray:
         """``steps`` fused greedy-decode steps; returns (len(uids), steps) tokens."""
         ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps)
-        toks, self.k_pages, self.v_pages = self._burst_fn(
+        self._rng, burst_rng = jax.random.split(self._rng)
+        toks, self.k_pages, self.v_pages = self._burst_for(self._sampling)(
             self.params, jnp.asarray(ids), jnp.asarray(positions), self.k_pages, self.v_pages,
-            jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last))
+            jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last), burst_rng)
         for seq in seqs:
             seq.post_forward()
         return np.asarray(toks[:n])
 
     # ---------------------------------------------------------- serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
-                 eos_token_id: Optional[int] = None) -> List[List[int]]:
-        """Greedy continuous-batching generation over a set of prompts.
+                 eos_token_id: Optional[int] = None, do_sample: bool = False, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> List[List[int]]:
+        """Continuous-batching generation over a set of prompts — greedy by
+        default, or sampled (``do_sample`` + temperature/top-k/top-p, the
+        MII frontend's sampling surface). Sampling happens on device (the
+        fused burst threads the rng through its scan), so the per-step
+        readback stays one int per sequence either way.
 
         Drives the scheduler the way a serving frontend (MII) drives the
         reference engine: admit prefills as KV blocks free up, batch all
         live decodes each step.
         """
+        self._sampling = (True, float(temperature), int(top_k), float(top_p)) if do_sample else None
+        self._rng = jax.random.PRNGKey(seed)
+        try:
+            return self._generate(prompts, max_new_tokens, eos_token_id)
+        finally:
+            self._sampling = None
+
+    def _generate(self, prompts, max_new_tokens, eos_token_id) -> List[List[int]]:
         reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
         pending = list(reqs.values())
         decode_ready: Dict[int, int] = {}  # uid -> next token to feed

@@ -154,15 +154,17 @@ def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
     return prefill, decode
 
 
-def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
-    """Jitted multi-step fused greedy decode.
+def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1,
+                  do_sample: bool = False, temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+    """Jitted multi-step fused decode (greedy or sampled).
 
     Runs ``steps`` paged-decode steps entirely on device under one
-    dispatch: each step's device-side argmax feeds the next step's input
-    ids, positions/context lengths advance in-graph, and the per-step KV
-    slots arrive precomputed because the host allocates blocks for the
-    whole burst up front. Returns the (B, steps) greedy tokens plus the
-    updated page pool.
+    dispatch: each step's device-side token choice (argmax, or the shared
+    ``sample_logits`` when sampling) feeds the next step's input ids,
+    positions/context lengths advance in-graph, and the per-step KV slots
+    arrive precomputed because the host allocates blocks for the whole
+    burst up front. Returns the (B, steps) tokens plus the updated page
+    pool.
 
     The reference hides per-step launch latency with CUDA-graph replay
     (``inference/engine.py:524``) and an async scheduler in front of
@@ -170,19 +172,22 @@ def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
     ``lax.scan`` program, which also amortizes the host<->device readback
     to ``1/steps`` of a token per step.
     """
+    from ..generation import sample_logits
+
     fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp)
 
-    def burst(params, ids0, positions0, k_pages, v_pages, block_tables, ctx0, slots, last):
+    def burst(params, ids0, positions0, k_pages, v_pages, block_tables, ctx0, slots, last, rng):
         # ids0/positions0 (B, 1); ctx0/last (B,); slots (steps, B)
         def step(carry, slots_t):
-            ids, kp, vp, off = carry
+            ids, kp, vp, off, rng = carry
             logits, kp, vp = fwd(params, ids, positions0 + off, kp, vp, block_tables,
                                  ctx0 + off, slots_t, last)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return (nxt[:, None], kp, vp, off + 1), nxt
+            rng, step_rng = jax.random.split(rng)
+            nxt = sample_logits(logits, step_rng, do_sample, temperature, top_k, top_p).astype(jnp.int32)
+            return (nxt[:, None], kp, vp, off + 1, rng), nxt
 
-        carry0 = (ids0, k_pages, v_pages, jnp.int32(0))
-        (_, k_pages, v_pages, _), toks = jax.lax.scan(step, carry0, slots)
+        carry0 = (ids0, k_pages, v_pages, jnp.int32(0), rng)
+        (_, k_pages, v_pages, _, _), toks = jax.lax.scan(step, carry0, slots)
         return toks.T, k_pages, v_pages
 
     return jax.jit(burst, donate_argnums=(3, 4))

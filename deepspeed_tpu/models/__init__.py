@@ -1,5 +1,5 @@
 from .transformer import (CausalLM, Transformer, TransformerConfig, cross_entropy_loss, gpt2_125m, gpt2_1_3b,
-                          gpt2_tiny, llama2_7b, llama_tiny)
+                          gpt2_tiny, llama2_7b, llama3_8b, llama_tiny)
 
 __all__ = ["Transformer", "TransformerConfig", "CausalLM", "cross_entropy_loss", "gpt2_tiny", "gpt2_125m",
-           "gpt2_1_3b", "llama_tiny", "llama2_7b"]
+           "gpt2_1_3b", "llama_tiny", "llama2_7b", "llama3_8b"]

@@ -789,3 +789,11 @@ def llama_tiny(**kw) -> TransformerConfig:
 def llama2_7b(**kw) -> TransformerConfig:
     return TransformerConfig(vocab_size=32000, n_layers=32, n_heads=32, d_model=4096, d_ff=11008, max_seq_len=4096,
                              norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False, **kw)
+
+
+def llama3_8b(**kw) -> TransformerConfig:
+    """Llama-3.1-8B geometry: GQA 4:1, theta 5e5, banded rope scaling."""
+    return TransformerConfig(vocab_size=128256, n_layers=32, n_heads=32, n_kv_heads=8, d_model=4096, d_ff=14336,
+                             max_seq_len=131072, norm="rmsnorm", activation="swiglu", pos_emb="rope",
+                             rope_theta=500000.0, rope_scaling="llama3", rope_factor=8.0,
+                             rope_orig_max_seq=8192, tie_embeddings=False, **kw)
