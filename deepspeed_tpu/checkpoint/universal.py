@@ -230,8 +230,34 @@ def _read_flat(zdir: str, fname: str, names: List[str]) -> Dict[str, np.ndarray]
     return out
 
 
+def _restore_scalar_training_state(engine, root: str, meta: Dict[str, Any],
+                                   load_optimizer_states: bool, load_lr_scheduler_states: bool) -> Dict[str, Any]:
+    """Loss scaler + LR schedule + counters — shared by the offload and
+    regular branches so a flag added here lands in both. The LR schedule
+    restores INDEPENDENTLY of the optimizer (a fresh-optimizer warm start
+    may keep its schedule); the loss scaler and step counters ride the
+    optimizer flag (they describe the optimizer trajectory)."""
+    scalar_state: Dict[str, Any] = {}
+    scalar_path = os.path.join(root, SCALAR_STATE)
+    if os.path.exists(scalar_path):
+        with open(scalar_path, "rb") as f:
+            scalar_state = pickle.load(f)
+    if load_optimizer_states and "__loss_scaler__" in scalar_state:
+        engine.loss_scaler.load_state_dict(scalar_state["__loss_scaler__"])
+    if load_lr_scheduler_states and "__lr_scheduler__" in scalar_state and engine.lr_scheduler is not None:
+        engine.lr_scheduler.load_state_dict(scalar_state["__lr_scheduler__"])
+    if load_optimizer_states:
+        counters = meta.get("counters", {})
+        engine.global_steps = int(counters.get("global_steps", engine.global_steps))
+        engine.micro_steps = int(counters.get("micro_steps", engine.micro_steps))
+        engine.global_samples = int(counters.get("global_samples", engine.global_samples))
+        engine.skipped_steps = int(counters.get("skipped_steps", engine.skipped_steps))
+    return scalar_state
+
+
 def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
-                              load_optimizer_states: bool = True) -> str:
+                              load_optimizer_states: bool = True,
+                              load_lr_scheduler_states: bool = True) -> str:
     """Load a universal checkpoint into a live engine at ANY mesh/stage.
 
     Reference analogue: ``universal_checkpoint.py:22
@@ -272,24 +298,14 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                                    f"for params: {lost[:5]}...")
                 trees.append(from_state_dict(template_host, unflatten_named(mom_flat)))
             offload.set_moments_trees(trees)
-            scalar_path = os.path.join(root, SCALAR_STATE)
+        scalar_state = _restore_scalar_training_state(engine, root, meta, load_optimizer_states,
+                                                      load_lr_scheduler_states)
+        if load_optimizer_states:
             counters0 = meta.get("counters", {})
             if "optim_step" in counters0:
                 offload.step_count = int(counters0["optim_step"])
-            if os.path.exists(scalar_path):
-                with open(scalar_path, "rb") as f:
-                    scalar_state = pickle.load(f)
-                if "optim_step" not in counters0 and "__offload_step__" in scalar_state:
-                    offload.step_count = int(scalar_state["__offload_step__"])
-                if "__loss_scaler__" in scalar_state:
-                    engine.loss_scaler.load_state_dict(scalar_state["__loss_scaler__"])
-                if "__lr_scheduler__" in scalar_state and engine.lr_scheduler is not None:
-                    engine.lr_scheduler.load_state_dict(scalar_state["__lr_scheduler__"])
-            counters = meta.get("counters", {})
-            engine.global_steps = int(counters.get("global_steps", engine.global_steps))
-            engine.micro_steps = int(counters.get("micro_steps", engine.micro_steps))
-            engine.global_samples = int(counters.get("global_samples", engine.global_samples))
-            engine.skipped_steps = int(counters.get("skipped_steps", engine.skipped_steps))
+            elif "__offload_step__" in scalar_state:
+                offload.step_count = int(scalar_state["__offload_step__"])
         return root
 
     if load_optimizer_states:
@@ -319,13 +335,5 @@ def load_universal_checkpoint(engine, load_dir: str, tag: Optional[str] = None,
                 # the step counter so Adam bias correction continues correctly
                 set_subtree(opt_sd, tuple(name.split(SEP)), np.asarray(optim_step, dtype=np.asarray(leaf).dtype))
         engine.opt_state = jax.device_put(from_state_dict(opt_host, opt_sd), engine.opt_state_shardings)
-        if "__loss_scaler__" in scalar_state:
-            engine.loss_scaler.load_state_dict(scalar_state["__loss_scaler__"])
-        if "__lr_scheduler__" in scalar_state and engine.lr_scheduler is not None:
-            engine.lr_scheduler.load_state_dict(scalar_state["__lr_scheduler__"])
-        counters = meta.get("counters", {})
-        engine.global_steps = int(counters.get("global_steps", engine.global_steps))
-        engine.micro_steps = int(counters.get("micro_steps", engine.micro_steps))
-        engine.global_samples = int(counters.get("global_samples", engine.global_samples))
-        engine.skipped_steps = int(counters.get("skipped_steps", engine.skipped_steps))
+    _restore_scalar_training_state(engine, root, meta, load_optimizer_states, load_lr_scheduler_states)
     return root

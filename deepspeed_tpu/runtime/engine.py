@@ -961,24 +961,36 @@ class DeepSpeedEngine:
             # keeping this method's contract: (path, client_state) return,
             # warn-and-fresh-start on a missing 'latest', fused-pending
             # handling identical to the regular route
-            if load_module_only or not load_lr_scheduler_states:
-                raise NotImplementedError("universal checkpoints restore the full training state; "
-                                          "module-only / no-scheduler loads need the native layout")
+            if load_module_only:
+                # reference load_module_only: weights only, optimizer and
+                # schedule stay fresh
+                load_optimizer_states = False
+                load_lr_scheduler_states = False
             if tag is None and not os.path.exists(os.path.join(load_dir, LATEST_FILENAME)):
                 logger.warning(f"no 'latest' file at {load_dir}; nothing loaded")
                 return None, {}
             if self._fused_pending is not None:
                 if not load_optimizer_states:
                     raise RuntimeError("load_checkpoint: a fused step is pending and this partial load "
-                                       "(load_optimizer_states=False) would not overwrite the optimizer "
-                                       "state it touched; call step() first")
+                                       "(load_module_only / load_optimizer_states=False) would not "
+                                       "overwrite the optimizer state it touched; call step() first")
                 self._fused_pending = None
                 self._cached_grads = None
                 log_dist("load_checkpoint: discarding a pending fused step — its state is being overwritten",
                          ranks=[0])
             path = self.load_universal_checkpoint(load_dir, tag=tag,
-                                                  load_optimizer_states=load_optimizer_states)
+                                                  load_optimizer_states=load_optimizer_states,
+                                                  load_lr_scheduler_states=load_lr_scheduler_states)
             self._post_load_derived_state()
+            if not load_optimizer_states and self.compression_engine is not None and path is not None:
+                # step-indexed compression schedules (QAT bit annealing,
+                # pruning offsets) anneal from the SAVED step even when the
+                # counters stay fresh — the native route's contract (see the
+                # TRAIN_META restore below)
+                from ..checkpoint.universal import inspect_universal_checkpoint
+
+                saved = inspect_universal_checkpoint(load_dir, tag).get("counters", {})
+                self.compression_engine.scheduler.training_steps = int(saved.get("global_steps", 0))
             return path, {}
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILENAME)
@@ -1080,12 +1092,14 @@ class DeepSpeedEngine:
 
         return save_universal_checkpoint(self, save_dir, tag)
 
-    def load_universal_checkpoint(self, load_dir: str, tag=None, load_optimizer_states: bool = True):
+    def load_universal_checkpoint(self, load_dir: str, tag=None, load_optimizer_states: bool = True,
+                                  load_lr_scheduler_states: bool = True):
         """Resume from a universal checkpoint at ANY mesh/zero-stage
         (reference ``universal_checkpoint.py:22``)."""
         from ..checkpoint.universal import load_universal_checkpoint
 
-        return load_universal_checkpoint(self, load_dir, tag, load_optimizer_states=load_optimizer_states)
+        return load_universal_checkpoint(self, load_dir, tag, load_optimizer_states=load_optimizer_states,
+                                         load_lr_scheduler_states=load_lr_scheduler_states)
 
 
 def initialize(args=None, model=None, optimizer=None, model_parameters=None, training_data=None, lr_scheduler=None,
