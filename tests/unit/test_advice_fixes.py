@@ -22,28 +22,35 @@ LLAMA_BASE = {
 }
 
 
-class TestRopeScalingRejected:
-    def test_nontrivial_rope_scaling_raises(self):
-        hf = dict(LLAMA_BASE, rope_scaling={"rope_type": "llama3", "factor": 8.0})
-        with pytest.raises(NotImplementedError, match="rope_scaling"):
-            config_from_hf(hf)
+class TestRopeScalingConfig:
+    """Round 4 turned the blanket rejection into support: linear/dynamic/
+    llama3/yarn map onto TransformerConfig rope_* fields (oracle parity in
+    test_hf_interop_archs); only longrope-class per-dim tables still raise."""
 
-    @pytest.mark.parametrize("kind", ["linear", "dynamic", "yarn", "longrope"])
-    def test_all_variants_rejected(self, kind):
-        hf = dict(LLAMA_BASE, rope_scaling={"type": kind, "factor": 2.0})
-        with pytest.raises(NotImplementedError):
+    @pytest.mark.parametrize("kind,extra", [
+        ("linear", {}), ("dynamic", {}),
+        ("llama3", {"low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                    "original_max_position_embeddings": 32}),
+        ("yarn", {"original_max_position_embeddings": 32}),
+    ])
+    def test_supported_variants_map(self, kind, extra):
+        hf = dict(LLAMA_BASE, rope_scaling={"rope_type": kind, "factor": 2.0, **extra})
+        cfg = config_from_hf(hf)
+        assert cfg.rope_scaling == kind and cfg.rope_factor == 2.0
+
+    def test_longrope_rejected(self):
+        hf = dict(LLAMA_BASE, rope_scaling={"rope_type": "longrope", "factor": 4.0,
+                                            "short_factor": [1.0], "long_factor": [2.0]})
+        with pytest.raises(NotImplementedError, match="longrope"):
             config_from_hf(hf)
 
     def test_trivial_or_absent_rope_scaling_ok(self):
-        config_from_hf(dict(LLAMA_BASE))  # absent
-        config_from_hf(dict(LLAMA_BASE, rope_scaling=None))
-        config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "default", "factor": 1.0}))
-        # linear/dynamic at factor 1.0 are identity scalings — must load
-        config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "linear", "factor": 1.0}))
-        config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "dynamic", "factor": 1.0}))
-        # yarn carries extra params even at factor 1 — still rejected
-        with pytest.raises(NotImplementedError):
-            config_from_hf(dict(LLAMA_BASE, rope_scaling={"type": "yarn", "factor": 1.0}))
+        for hf in (dict(LLAMA_BASE), dict(LLAMA_BASE, rope_scaling=None),
+                   dict(LLAMA_BASE, rope_scaling={"type": "default", "factor": 1.0}),
+                   # linear/dynamic at factor 1.0 are identity scalings
+                   dict(LLAMA_BASE, rope_scaling={"type": "linear", "factor": 1.0}),
+                   dict(LLAMA_BASE, rope_scaling={"type": "dynamic", "factor": 1.0})):
+            assert config_from_hf(hf).rope_scaling is None
 
 
 class TestWindowWithoutCausal:

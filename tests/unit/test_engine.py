@@ -490,8 +490,12 @@ def test_stage3_gather_16bit_on_save_and_universal_load_knobs(tmp_path):
     assert e2.load_checkpoint(str(tmp_path / "nowhere")) == (None, {})
     path, client_state = e2.load_checkpoint(str(tmp_path / "uni"), tag="u1")
     assert path is not None and client_state == {}
-    with pytest.raises(NotImplementedError):
-        e2.load_checkpoint(str(tmp_path / "uni"), tag="u1", load_module_only=True)
+    # module-only via the universal route (round 4): weights land, the
+    # engine's training counters stay untouched — perturb the counter so a
+    # regression restoring it from the checkpoint (== 1 here) is caught
+    e2.global_steps = 7
+    path, _ = e2.load_checkpoint(str(tmp_path / "uni"), tag="u1", load_module_only=True)
+    assert path is not None and e2.global_steps == 7
     w1 = np.asarray(jax.device_get(jax.tree_util.tree_leaves(engine.params)[0]))
     w2 = np.asarray(jax.device_get(jax.tree_util.tree_leaves(e2.params)[0]))
     np.testing.assert_allclose(w1, w2, rtol=1e-6, atol=1e-6)

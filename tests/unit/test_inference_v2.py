@@ -431,6 +431,21 @@ class TestSampledServing:
         sampled = eng.generate(prompts, max_new_tokens=8, do_sample=True, top_k=1, seed=3)
         assert sampled == greedy
 
+    def test_topk1_matches_greedy_tp2(self, v2_setup):
+        """Sampling composes with TP serving: the device-side choice runs
+        on the (possibly sharded) logits."""
+        import dataclasses
+
+        from deepspeed_tpu.parallel.mesh import reset_mesh
+
+        model, params, cfg = v2_setup
+        reset_mesh()
+        eng = InferenceEngineV2(model, params, dataclasses.replace(cfg, tensor_parallel=2))
+        prompts = [[3, 17, 42, 9]]
+        greedy = eng.generate(prompts, max_new_tokens=6)
+        sampled = eng.generate(prompts, max_new_tokens=6, do_sample=True, top_k=1, seed=9)
+        assert sampled == greedy
+
     def test_sampling_reproducible_and_varies(self, v2_setup):
         model, params, cfg = v2_setup
         eng = InferenceEngineV2(model, params, cfg)
