@@ -123,9 +123,15 @@ def _mesh_has_axis(axis: str) -> bool:
         return False
 
 
+# expert dim over `expert` (EP), FFN dim over `tensor` — megatron-style
+# per-expert TP (reference expert-tensor-parallelism, moe/mappings.py +
+# FastGen's TP-sharded experts). GSPMD partitions the training einsums AND
+# the serving `lax.ragged_dot` grouped GEMMs this way with only the
+# canonical row-parallel allreduce (verified: no weight gathers in HLO),
+# so Mixtral-class expert memory scales with tp instead of replicating.
 MOE_PARTITION_RULES = [
-    (("experts", "wi"), P("expert", None, None)),
-    (("experts", "wo"), P("expert", None, None)),
-    (("experts", "wg"), P("expert", None, None)),
+    (("experts", "wi"), P("expert", None, "tensor")),
+    (("experts", "wo"), P("expert", "tensor", None)),
+    (("experts", "wg"), P("expert", None, "tensor")),
     (("gate", "kernel"), P(None, None)),
 ]

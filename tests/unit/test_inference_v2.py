@@ -459,6 +459,30 @@ class TestSampledServing:
         assert eng._sampling is None
 
 
+def test_moe_expert_tp_serving():
+    """Mixtral-style MoE serving under TP=2: expert FFN weights shard over
+    the tensor axis (megatron-style per-expert TP — FastGen TP-shards
+    experts too) instead of replicating, and generation still matches the
+    dense oracle."""
+    from deepspeed_tpu.parallel.mesh import reset_mesh
+
+    cfg = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2, d_model=32, max_seq_len=64,
+                            norm="rmsnorm", activation="swiglu", pos_emb="rope", tie_embeddings=False,
+                            moe_num_experts=4, moe_top_k=2, moe_layer_freq=1, d_ff=64)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(6), {"input_ids": np.zeros((1, 8), np.int32)})
+    reset_mesh()
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        state_manager=RaggedBatchConfig(kv_block_size=8, max_context=64, num_kv_blocks=48),
+        dtype="float32", tensor_parallel=2))
+    wi = eng.params["layer_0"]["moe"]["experts"]["wi"]
+    assert tuple(wi.sharding.spec) == ("expert", None, "tensor"), wi.sharding
+    prompt = [3, 17, 42, 9, 88, 5]
+    out = eng.generate([prompt], max_new_tokens=6)[0]
+    reset_mesh()
+    assert out == _dense_generate(model, params, prompt, 6)
+
+
 def test_rope_scaling_serving():
     """llama-3.1-style banded rope scaling through the ragged engine: the
     paged runner's frequency tables must match the dense model's."""
