@@ -148,36 +148,30 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
 
 
 def _probe_backend(timeout_s: float = 180.0):
-    """Initialize the jax backend under a watchdog: a wedged TPU tunnel makes
-    the first device query hang forever — exit loudly instead of hanging the
+    """Initialize the jax backend under a watchdog (shared protocol:
+    ``deepspeed_tpu/utils/watchdog.py``): a wedged TPU tunnel makes the
+    first device query hang forever — exit loudly instead of hanging the
     driver (the stuck init thread cannot be cancelled, hence os._exit)."""
-    import threading
-
-    result = {}
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from deepspeed_tpu.utils.watchdog import run_with_watchdog
 
     def probe():
-        try:
-            import jax
+        import jax
 
-            if os.environ.get("DS_BENCH_CPU") == "1":
-                # sitecustomize pins the tunnel platform before env vars can
-                # act; the config override still works (backends are lazy)
-                jax.config.update("jax_platforms", "cpu")
-            result["n"] = jax.device_count()
-            result["platform"] = jax.devices()[0].platform
-        except BaseException as e:  # noqa: BLE001 - surfaced on the main thread
-            result["err"] = e
+        if os.environ.get("DS_BENCH_CPU") == "1":
+            # sitecustomize pins the tunnel platform before env vars can
+            # act; the config override still works (backends are lazy)
+            jax.config.update("jax_platforms", "cpu")
+        return jax.device_count(), jax.devices()[0].platform
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if "err" in result:
-        raise result["err"]  # a real init failure, not a hang — keep the traceback
-    if "platform" not in result:
+    status, value = run_with_watchdog(probe, timeout_s)
+    if status == "error":
+        raise value  # a real init failure, not a hang — keep the traceback
+    if status == "timeout":
         print(f"[bench] jax backend init did not complete within {timeout_s:.0f}s — "
               "TPU tunnel unreachable; aborting instead of hanging", file=sys.stderr)
         os._exit(1)
-    return result["n"], result["platform"]
+    return value
 
 
 def run_attention_rep(jax, jnp, np, platform, iters=10):

@@ -21,6 +21,32 @@ def _try_version(mod_name: str) -> str:
         return f"NOT AVAILABLE ({type(e).__name__})"
 
 
+def _probe_devices(timeout_s: float = 180.0):
+    """Backend facts under a watchdog: the first device query against a
+    wedged TPU tunnel hangs forever, and a diagnostic tool must not hang
+    on the very environment it exists to diagnose. 180s matches
+    ``bench.py``'s probe budget — real pod inits can take minutes.
+    Returns ``(report_lines, backend_alive)``."""
+    from .utils.watchdog import run_with_watchdog
+
+    def probe():
+        import jax
+
+        backend = jax.default_backend()
+        devs = jax.devices()
+        return [f"backend .............. {backend}",
+                f"devices .............. {len(devs)} x {devs[0].device_kind if devs else '-'}",
+                f"process count ........ {jax.process_count()} (index {jax.process_index()})"]
+
+    status, value = run_with_watchdog(probe, timeout_s)
+    if status == "error":
+        return [f"backend .............. FAILED: {type(value).__name__}: {value}"], False
+    if status == "timeout":
+        return [f"backend .............. UNREACHABLE (device probe did not return within {timeout_s:.0f}s — "
+                "dead TPU tunnel?)"], False
+    return value, True
+
+
 def report_string() -> str:
     from .version import __version__
 
@@ -30,30 +56,28 @@ def report_string() -> str:
         lines.append(f"{dep:.<20} {_try_version(dep)}")
     lines.append(f"python ............... {sys.version.split()[0]} ({platform.platform()})")
 
-    try:
-        import jax
-
-        lines.append(f"backend .............. {jax.default_backend()}")
-        devs = jax.devices()
-        lines.append(f"devices .............. {len(devs)} x {devs[0].device_kind if devs else '-'}")
-        lines.append(f"process count ........ {jax.process_count()} (index {jax.process_index()})")
-    except Exception as e:  # noqa: BLE001
-        lines.append(f"backend .............. FAILED: {e}")
+    dev_lines, backend_alive = _probe_devices()
+    lines.extend(dev_lines)
 
     for var in ("JAX_PLATFORMS", "XLA_FLAGS", "TPU_NAME", "MASTER_ADDR", "WORLD_SIZE", "RANK"):
         if var in os.environ:
             lines.append(f"env {var} = {os.environ[var]}")
 
     lines.append("-" * 70)
-    try:
-        from .ops.registry import REGISTRY
+    if backend_alive:
+        try:
+            from .ops.registry import REGISTRY
 
-        # importing the kernels registers their impls
-        from .ops import pallas as _  # noqa: F401
+            # importing the kernels registers their impls
+            from .ops import pallas as _  # noqa: F401
 
-        lines.append(REGISTRY.report())
-    except Exception as e:  # noqa: BLE001
-        lines.append(f"op registry .......... FAILED: {e}")
+            lines.append(REGISTRY.report())
+        except Exception as e:  # noqa: BLE001
+            lines.append(f"op registry .......... FAILED: {e}")
+    else:
+        # op selection needs a live backend (pallas availability probes it);
+        # the stuck init thread would block any further jax call
+        lines.append("op registry .......... skipped (backend unreachable)")
 
     lines.append("-" * 70)
     try:

@@ -1,0 +1,37 @@
+"""Watchdog for calls that can hang forever (wedged TPU tunnel).
+
+The first jax backend/device query against a dead tunnel blocks
+indefinitely and cannot be cancelled; everything that probes the backend
+(``bench.py``, ``env_report``) shares this one spawn/join/timeout
+protocol so the tunnel-handling behavior cannot drift between
+diagnostics.
+"""
+
+import threading
+from typing import Any, Callable, Tuple
+
+
+def run_with_watchdog(fn: Callable[[], Any], timeout_s: float) -> Tuple[str, Any]:
+    """Run ``fn()`` on a daemon thread with a deadline.
+
+    Returns ``("ok", result)``, ``("error", exception)``, or
+    ``("timeout", None)``. On timeout the thread is still stuck inside
+    ``fn`` (likely holding the backend-init lock), so the caller must not
+    make further backend calls in this process.
+    """
+    box: dict = {}
+
+    def run():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - surfaced to the caller
+            box["error"] = e
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if "error" in box:
+        return "error", box["error"]
+    if "value" in box:
+        return "ok", box["value"]
+    return "timeout", None
