@@ -152,7 +152,6 @@ def _probe_backend(timeout_s: float = 180.0):
     ``deepspeed_tpu/utils/watchdog.py``): a wedged TPU tunnel makes the
     first device query hang forever — exit loudly instead of hanging the
     driver (the stuck init thread cannot be cancelled, hence os._exit)."""
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from deepspeed_tpu.utils.watchdog import run_with_watchdog
 
     def probe():

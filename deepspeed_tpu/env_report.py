@@ -40,7 +40,9 @@ def _probe_devices(timeout_s: float = 180.0):
 
     status, value = run_with_watchdog(probe, timeout_s)
     if status == "error":
-        return [f"backend .............. FAILED: {type(value).__name__}: {value}"], False
+        # clean failure: no thread is stuck, further jax calls return
+        # promptly, so the registry section may still be attempted
+        return [f"backend .............. FAILED: {type(value).__name__}: {value}"], True
     if status == "timeout":
         return [f"backend .............. UNREACHABLE (device probe did not return within {timeout_s:.0f}s — "
                 "dead TPU tunnel?)"], False
@@ -56,7 +58,7 @@ def report_string() -> str:
         lines.append(f"{dep:.<20} {_try_version(dep)}")
     lines.append(f"python ............... {sys.version.split()[0]} ({platform.platform()})")
 
-    dev_lines, backend_alive = _probe_devices()
+    dev_lines, backend_responsive = _probe_devices()
     lines.extend(dev_lines)
 
     for var in ("JAX_PLATFORMS", "XLA_FLAGS", "TPU_NAME", "MASTER_ADDR", "WORLD_SIZE", "RANK"):
@@ -64,7 +66,7 @@ def report_string() -> str:
             lines.append(f"env {var} = {os.environ[var]}")
 
     lines.append("-" * 70)
-    if backend_alive:
+    if backend_responsive:
         try:
             from .ops.registry import REGISTRY
 
@@ -75,8 +77,8 @@ def report_string() -> str:
         except Exception as e:  # noqa: BLE001
             lines.append(f"op registry .......... FAILED: {e}")
     else:
-        # op selection needs a live backend (pallas availability probes it);
-        # the stuck init thread would block any further jax call
+        # the stuck init thread (timeout case only) would block any
+        # further jax call, op selection included
         lines.append("op registry .......... skipped (backend unreachable)")
 
     lines.append("-" * 70)

@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Digest the hardware-session artifacts into one readable summary.
+
+Reads whatever exists of BENCH_extra.json, TRAIN_SWEEP.jsonl, and the
+hw_session log, and prints a PERF_NOTES-ready table: rung, value, unit,
+vs_baseline, plus the train-sweep ladder and any failed rungs. Run after
+(or during — artifacts are incremental) a `tools/hw_session.sh` window.
+"""
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    bench_path = os.path.join(ROOT, "BENCH_extra.json")
+    if os.path.exists(bench_path):
+        with open(bench_path) as f:
+            extra = json.load(f)
+        print("== bench rungs (BENCH_extra.json) ==")
+        for rung, rec in extra.items():
+            if "error" in rec:
+                print(f"  {rung:<10} FAILED: {rec['error']}")
+                continue
+            vs = rec.get("vs_baseline")
+            impls = rec.get("impls")
+            line = f"  {rung:<10} {rec.get('value'):>12} {rec.get('unit', ''):<14} vs_baseline={vs}"
+            if impls:
+                line += f"  impls={impls} winner={rec.get('winner')}"
+            print(line)
+    else:
+        print("no BENCH_extra.json yet")
+
+    sweep_path = os.path.join(ROOT, "TRAIN_SWEEP.jsonl")
+    if os.path.exists(sweep_path):
+        print("== train sweep (TRAIN_SWEEP.jsonl) ==")
+        best = (None, 0.0)
+        with open(sweep_path) as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    print(f"  (unparseable row: {line.strip()[:80]!r})")
+                    continue
+                res = row.get("result")
+                if not res:
+                    print(f"  {row['combo']:<16} FAILED")
+                    continue
+                print(f"  {row['combo']:<16} {res['value']:>12} tok/s/chip  vs_baseline={res['vs_baseline']}")
+                if res["value"] > best[1]:
+                    best = (row["combo"], res["value"])
+        if best[0]:
+            print(f"  -> best: {best[0]} at {best[1]} tok/s/chip")
+
+    for log in ("hw_session_r4.log", "hw_session.log"):
+        p = os.path.join(ROOT, log)
+        if os.path.exists(p):
+            print(f"== session notes ({log}) ==")
+            with open(p, errors="replace") as f:
+                for line in f:
+                    if line.startswith("[hw_session"):
+                        print(" ", line.rstrip())
+            break
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
