@@ -35,4 +35,5 @@ note "4/4 train flag/block sweep (TRAIN_SWEEP.jsonl)"
 bash tools/train_sweep.sh >> "$LOG" 2>&1
 note "train sweep rc=$?"
 
-note "session complete - artifacts: BENCH_extra.json + TRAIN_SWEEP.jsonl + $LOG"
+python tools/hw_summary.py > HW_SUMMARY.txt 2>&1
+note "session complete - artifacts: BENCH_extra.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + $LOG"
