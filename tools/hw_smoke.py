@@ -150,6 +150,10 @@ def _ops():
             greedy = np.asarray(jnp.argmax(logits[0], axis=-1))
             for t, tok in enumerate(o):
                 assert tok == int(greedy[len(p) - 1 + t]), (p, t, tok, int(greedy[len(p) - 1 + t]))
+        # sampled burst (rng threads through the scan) compiles + top_k=1
+        # still equals greedy on real Mosaic
+        outs_k1 = eng.generate(prompts, max_new_tokens=10, do_sample=True, top_k=1, seed=3)
+        assert outs_k1 == outs, (outs_k1, outs)
 
     def qmm():
         # fused dequant-matmul vs its XLA oracle on the real Mosaic lowering
