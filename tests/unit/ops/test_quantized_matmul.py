@@ -110,3 +110,35 @@ def test_int4_against_dense_accuracy():
     dense = jax.lax.dot_general(x, w, (((1,), (0,)), ((), ())))
     rel = float(jnp.max(jnp.abs(got - dense)) / jnp.max(jnp.abs(dense)))
     assert rel < 0.2, rel  # int4: ~16 levels per group
+
+
+@pytest.mark.parametrize("kspec,nspec", [
+    (None, "tensor"),      # column-parallel (q/k/v/up/gate/lm_head)
+    ("tensor", None),      # row-parallel (o_proj/down_proj): local + psum
+    (None, None),          # replicated
+])
+def test_sharded_wrapper_partitions(kspec, nspec):
+    """quantized_matmul_sharded (custom_partitioning): each shard runs the
+    local kernel; K-sharded codes psum their partials; results match the
+    unsharded oracle bit-tight for every sharding the serving layer uses."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deepspeed_tpu.ops.pallas.quantized_matmul import quantized_matmul_sharded
+    from deepspeed_tpu.parallel.mesh import initialize_mesh, reset_mesh
+    from deepspeed_tpu.runtime.config import MeshConfig
+
+    reset_mesh()
+    topo = initialize_mesh(MeshConfig.from_dict({"data": -1, "tensor": 2}), force=True)
+    mesh = topo.mesh
+    w, x = _wx(K=256, N=384, M=16)
+    # shard-aligned groups: g=128 divides K/2=128, so scales split with K
+    q, s = quantize_weight_kgroups(w, group_size=128)
+    ref = quantized_matmul_xla(x, q, s)
+
+    qs = jax.device_put(q, NamedSharding(mesh, P(kspec, nspec)))
+    ss = jax.device_put(s, NamedSharding(mesh, P(kspec, nspec)))
+    xs = jax.device_put(x, NamedSharding(mesh, P(None, kspec)))
+    with mesh:
+        out = jax.jit(lambda x, q, s: quantized_matmul_sharded(x, q, s))(xs, qs, ss)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6, atol=1e-6)
+    reset_mesh()
