@@ -301,7 +301,9 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             # peak on useful FLOPs (BASELINE.md "attention target")
             name = "attention_llama7b_shape_fwd_bwd_tflops_per_sec" + \
                 ("_s4096_d128_gqa8" if platform == "tpu" else "_cpu")
-            vs = round(tfs[winner] / 98.5, 4)
+            # the 98.5 TF/s target is 50% of *v5e* peak — meaningless off-TPU,
+            # so CPU runs report the absolute TF/s only
+            vs = round(tfs[winner] / 98.5, 4) if platform == "tpu" else None
         elif rung == "attn_d64":
             # VPU-bound shape: kernel-selection speedup over the XLA impl.
             # A missing baseline must raise, not report 0.0 (a silent 0.0

@@ -172,8 +172,8 @@ class InferenceEngineV2:
 
         The cache is bounded: sampling params are user floats, so a
         frontend forwarding per-request temperatures would otherwise grow
-        compiled burst programs without limit — oldest signature evicted
-        (its executables free with the jit wrapper)."""
+        compiled burst programs without limit — least-recently-used
+        signature evicted (its executables free with the jit wrapper)."""
         if self._config.decode_burst < 2:
             return None
         key = sampling or (False, 1.0, 0, 1.0)
@@ -183,6 +183,10 @@ class InferenceEngineV2:
             do, t, k, p = key
             self._bursts[key] = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
                                               tp=self._tp, do_sample=do, temperature=t, top_k=k, top_p=p)
+        else:
+            # LRU touch: keep a hot signature (e.g. greedy) from being
+            # evicted by a frontend cycling through >8 sampling configs
+            self._bursts[key] = self._bursts.pop(key)
         return self._bursts[key]
 
     def _choose_tokens(self, logits) -> np.ndarray:

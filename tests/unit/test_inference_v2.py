@@ -214,6 +214,30 @@ class TestDecodeBurst:
         assert out == full[:full.index(eos) + 1]
         assert eng.state.free_blocks == free0  # flushed despite early EOS
 
+    def test_burst_cache_lru_eviction(self, v2_setup, monkeypatch):
+        """The bounded burst-program cache evicts least-recently-USED, not
+        first-inserted: a hot signature (e.g. greedy) touched between other
+        lookups must survive a frontend cycling through >_MAX_BURST_VARIANTS
+        sampling configs (ADVICE r4)."""
+        import dataclasses
+        from deepspeed_tpu.inference.v2 import engine_v2 as ev2
+        model, params, cfg = v2_setup
+        eng = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=8))
+        built = []
+        monkeypatch.setattr(ev2, "make_burst_fn",
+                            lambda *a, **kw: built.append(kw.get("temperature")) or object())
+        greedy = eng._burst_for(None)
+        cap = eng._MAX_BURST_VARIANTS
+        for i in range(cap - 1):  # fill the cache alongside greedy
+            eng._burst_for((True, 1.0 + i, 0, 1.0))
+        assert eng._burst_for(None) is greedy  # touch: greedy is now MRU
+        eng._burst_for((True, 99.0, 0, 1.0))   # overflow evicts the LRU...
+        assert eng._burst_for(None) is greedy  # ...which must not be greedy
+        # the evicted victim (oldest untouched signature) rebuilds on reuse
+        n = len(built)
+        eng._burst_for((True, 1.0, 0, 1.0))
+        assert len(built) == n + 1
+
     def test_burst_respects_kv_pressure(self, v2_setup):
         """With a pool too small for a full burst the ladder shrinks (or
         falls back to single steps) instead of failing allocation."""
