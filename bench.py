@@ -39,10 +39,102 @@ the tunneled single-chip setup a host roundtrip costs ~100ms, which would
 otherwise dominate the measurement.
 """
 
+import hashlib
 import json
 import os
 import sys
 import time
+
+# ---------------------------------------------------------------------------
+# FROZEN BENCH CONTRACT (BASELINE.md "Frozen rung contract")
+#
+# Two rounds of target re-derivation made cross-round numbers incomparable;
+# from round 5 on the accounting is data, hashed, and guarded: every rung's
+# shape/formula/baseline lives in RUNG_CONTRACTS, the code below reads its
+# numeric constants FROM the contract, and _check_frozen() refuses to emit a
+# rung whose contract hash differs from the frozen table. Changing a target
+# now requires editing BOTH this dict and the freeze hashes + BASELINE.md —
+# a conscious, documented act rather than a drive-by constant edit.
+# ---------------------------------------------------------------------------
+RUNG_CONTRACTS = {
+    "zero2": {
+        "model": "gpt2-124M: L12 d768 H12 V50257 S1024 bf16",
+        "measure": "train tokens/s/chip, fwd+bwd+step, best micro-batch of [8,16,32]",
+        "accounting": "6*N + 12*L*d*S ~= 0.86 GF/token",
+        "baseline_tokens_per_sec_chip": 114000.0,
+        "derivation": "A100 312 bf16 TF/s at DeepSpeed-class 50% MFU = 181k tok/s; x197/312 v5e = 114k",
+        "ceiling_vs_baseline": 2.0,
+    },
+    "zero3": {
+        "model": "gpt2-124M: L12 d768 H12 V50257 S1024 bf16",
+        "measure": "train tokens/s/chip under ZeRO-3 machinery, best micro-batch of [8,16,32]",
+        "accounting": "same as zero2 (stage 3 on one chip must not regress)",
+        "baseline_tokens_per_sec_chip": 114000.0,
+        "derivation": "same as zero2",
+        "ceiling_vs_baseline": 2.0,
+    },
+    "decode": {
+        "model": "gpt2-124M bf16, v1 engine, greedy, batch 32, prompt 128, 64 new tokens",
+        "measure": "decode tokens/s/chip, differential timing (prefill cancelled)",
+        "accounting": "HBM-bound: 0.25 GB params/step at ~820 GB/s -> ~3.2k steps/s x 32 seq x ~25%",
+        "baseline_tokens_per_sec_chip": 25000.0,
+    },
+    "serve": {
+        "model": "gpt2-124M bf16, v2 ragged engine, 32 mixed-length prompts, 128 new tokens",
+        "measure": "serving-loop generated tokens/s/chip (chunked prefill + paged burst decode)",
+        "accounting": "same HBM-bound derivation as decode plus scheduling overhead",
+        "baseline_tokens_per_sec_chip": 25000.0,
+    },
+    "attn": {
+        "shape": "B2 S4096 H32 KVH4 D128 causal, full fwd+bwd (grads wrt q,k,v)",
+        "measure": "useful TF/s of the winning attention impl",
+        "accounting": "7*B*H*S^2*D after the x1/2 causal discount (fwd 2 matmuls, bwd 5)",
+        "target_tflops": 98.5,
+        "derivation": "50% of v5e bf16 peak (197 TF/s) on useful FLOPs; causal skipping enforced by construction",
+    },
+    "attn_d64": {
+        "shape": "B8 S1024 H12 D64 causal fwd+bwd (the zero2 train shape)",
+        "measure": "winner/xla speedup (kernel-selection rung; VPU-bound shape)",
+        "baseline": "always-available XLA attention at the same shape",
+    },
+    "longctx": {
+        "shape": "B1 S8192 H12 D64 causal fwd+bwd",
+        "measure": "winner/chunked speedup",
+        "baseline": "O(S*chunk) online-softmax chunked fallback",
+    },
+}
+
+# sha256[:16] of each contract's canonical JSON — regenerate ONLY as a
+# deliberate freeze update, mirrored in BASELINE.md:
+#   python -c "import bench; print(bench.freeze_table())"
+FROZEN_HASHES = {
+    "zero2": "fdc921b5871fccaf",
+    "zero3": "68f02dbbe3404e65",
+    "decode": "c9c5e4e408065244",
+    "serve": "e39f632039a0821a",
+    "attn": "779084b20083fd56",
+    "attn_d64": "73ea8908662973d7",
+    "longctx": "d12d5cc4417623bf",
+}
+
+
+def _contract_hash(rung: str) -> str:
+    blob = json.dumps(RUNG_CONTRACTS[rung], sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def freeze_table() -> str:
+    return "\n".join(f"| `{r}` | `{_contract_hash(r)}` |" for r in RUNG_CONTRACTS)
+
+
+def _check_frozen(rung: str) -> None:
+    h = _contract_hash(rung)
+    want = FROZEN_HASHES.get(rung)
+    if h != want:
+        raise RuntimeError(
+            f"bench accounting for rung {rung!r} changed: contract hash {h} != frozen {want}. "
+            "Round-5 freeze (BASELINE.md): numbers must stay comparable across rounds. If the "
+            "change is deliberate, update FROZEN_HASHES and BASELINE.md's frozen table together.")
 
 
 def run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters, stage=2):
@@ -267,11 +359,12 @@ def _attention_ab(jax, jnp, shape, iters, impls, kvh=None):
 
 def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, sweep, iters,
                  decode_bs, decode_new, tag):
+    _check_frozen(rung)
     if rung == "decode":
         tps = run_decode(jax, jnp, np, cfg_model, decode_bs, prompt_len=128, new_tokens=decode_new)
         # decode runs replicated (tp=1, batch unsharded): the measured rate
         # IS the per-chip rate — dividing by n_dev would undercount
-        baseline = 25_000.0  # see module docstring
+        baseline = RUNG_CONTRACTS["decode"]["baseline_tokens_per_sec_chip"]
         return {
             "metric": f"gpt2-125m_bf16_greedy_decode_tokens_per_sec_per_chip{tag}",
             "value": round(tps, 1),
@@ -283,7 +376,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
         tps = run_serve(jax, jnp, np, cfg_model, serve_prompts, prompt_len=decode_bs * 4, new_tokens=serve_new)
         # same HBM-bound derivation as decode (module docstring); the serving
         # loop additionally carries prefill + scheduling overhead
-        baseline = 25_000.0
+        baseline = RUNG_CONTRACTS["serve"]["baseline_tokens_per_sec_chip"]
         return {
             "metric": f"gpt2-125m_bf16_ragged_serve_tokens_per_sec_per_chip{tag}",
             "value": round(tps, 1),
@@ -301,9 +394,10 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             # peak on useful FLOPs (BASELINE.md "attention target")
             name = "attention_llama7b_shape_fwd_bwd_tflops_per_sec" + \
                 ("_s4096_d128_gqa8" if platform == "tpu" else "_cpu")
-            # the 98.5 TF/s target is 50% of *v5e* peak — meaningless off-TPU,
+            # the TF/s target is 50% of *v5e* peak — meaningless off-TPU,
             # so CPU runs report the absolute TF/s only
-            vs = round(tfs[winner] / 98.5, 4) if platform == "tpu" else None
+            target = RUNG_CONTRACTS["attn"]["target_tflops"]
+            vs = round(tfs[winner] / target, 4) if platform == "tpu" else None
         elif rung == "attn_d64":
             # VPU-bound shape: kernel-selection speedup over the XLA impl.
             # A missing baseline must raise, not report 0.0 (a silent 0.0
@@ -340,7 +434,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
     if best[1] is None:
         raise RuntimeError("every sweep config failed")
     tokens_per_sec_chip = best[0] / n_dev
-    baseline_tokens_per_sec_chip = 114_000.0  # see module docstring (MFU-derived)
+    baseline_tokens_per_sec_chip = RUNG_CONTRACTS[rung]["baseline_tokens_per_sec_chip"]
     return {
         "metric": f"gpt2-125m_zero{stage}_bf16_train_tokens_per_sec_per_chip{tag}" if platform == "tpu"
         else f"tiny_zero{stage}_bf16_train_tokens_per_sec_per_chip{tag}",

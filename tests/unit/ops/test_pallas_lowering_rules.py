@@ -122,6 +122,64 @@ def test_flash_attention_specs(record):
         jax.grad(fn, argnums=(0, 1, 2))(q, k, v)
 
 
+def test_flash_attention_gqa_collapsed_specs(record):
+    """Round-4 rewrite: GQA keeps KV collapsed at (B, S, KVH, D) through
+    fwd AND bwd (``_dkv_kernel_gqa`` runs a (B*KVH, Sk//bk, n_rep) grid).
+    Every collapsed-KV BlockSpec — including the ALiBi slopes table and
+    window masking that broke on real Mosaic in round 3 — must satisfy
+    the (8, 128) tiling rule at GQA shapes too."""
+    from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+
+    B, S, H, KVH, D = 2, 256, 8, 2, 64
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(k2, (B, S, KVH, D), jnp.bfloat16)
+    v = jax.random.normal(k3, (B, S, KVH, D), jnp.bfloat16)
+    slopes = np.geomspace(0.25, 0.001, H).astype(np.float32)
+    bias_collapsed = jax.random.normal(jax.random.PRNGKey(1), (1, 1, 1, S), jnp.float32)
+
+    for kwargs in (dict(causal=True), dict(causal=True, alibi_slopes=slopes),
+                   dict(causal=True, window=64), dict(causal=False, bias=bias_collapsed)):
+        fn = lambda q, k, v: flash_attention(q, k, v, interpret=True, **kwargs).astype(jnp.float32).sum()
+        jax.grad(fn, argnums=(0, 1, 2))(q, k, v)
+
+    # the llama-7B bench geometry's GQA ratio (8:1) at a CI-sized S
+    q8 = jax.random.normal(k1, (1, 256, 8, 128), jnp.bfloat16)
+    kv8 = jax.random.normal(k2, (1, 256, 1, 128), jnp.bfloat16)
+    fn = lambda q, k, v: flash_attention(q, k, v, causal=True, interpret=True).astype(jnp.float32).sum()
+    jax.grad(fn, argnums=(0, 1, 2))(q8, kv8, kv8)
+
+
+def test_quantized_matmul_tp_shard_specs(record):
+    """Round-4 rewrite: under TP serving, ``quantized_matmul_sharded``'s
+    ``custom_partitioning`` re-invokes the fused kernel with PER-SHARD
+    shapes (column-parallel: N/tp columns; row-parallel: K/tp rows with
+    K-groups shard-local). Those shard shapes — not the full-array ones
+    the plain spec test drives — are what real Mosaic lowers on a pod,
+    so the tiling rule must hold for every TP degree the engines use."""
+    from deepspeed_tpu.ops.pallas.quantized_matmul import (quantize_weight_kgroups,
+                                                           quantized_matmul_pallas)
+
+    K, N, group = 256, 512, 128
+    w = jax.random.normal(jax.random.PRNGKey(0), (K, N), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(1), (16, K), jnp.bfloat16)
+    for tp in (2, 4, 8):
+        # column-parallel shard: full K, N/tp columns (quantize-after-sharding)
+        qc, sc = quantize_weight_kgroups(w[:, : N // tp], group_size=group)
+        quantized_matmul_pallas(x, qc, sc, interpret=True)
+        quantized_matmul_pallas(x[:2], qc, sc, interpret=True)  # decode M
+        # row-parallel shard: K/tp rows; groups align to the split so the
+        # shard quantizes standalone (group <= K/tp enforced by serving)
+        k_shard = K // tp
+        qr, sr = quantize_weight_kgroups(w[:k_shard], group_size=min(group, k_shard))
+        quantized_matmul_pallas(x[:, :k_shard], qr, sr, interpret=True)
+    # int4 packed at tp=2, both parallelisms
+    q4c, s4c = quantize_weight_kgroups(w[:, : N // 2], group_size=group, bits=4, pack=True)
+    quantized_matmul_pallas(x, q4c, s4c, packed=True, interpret=True)
+    q4r, s4r = quantize_weight_kgroups(w[: K // 2], group_size=group, bits=4, pack=True)
+    quantized_matmul_pallas(x[:, : K // 2], q4r, s4r, packed=True, interpret=True)
+
+
 def test_paged_attention_specs(record):
     pltpu = pytest.importorskip("jax.experimental.pallas.tpu")  # noqa: F841
     from deepspeed_tpu.ops.pallas.paged_attention import paged_attention_decode, paged_attention_prefill

@@ -1,0 +1,58 @@
+"""The frozen bench contract (BASELINE.md "Frozen rung contract").
+
+Round-5 freeze: rung accounting is data (`bench.RUNG_CONTRACTS`), hashed,
+and `bench.py` must refuse to emit a rung whose accounting drifted from
+`FROZEN_HASHES`. These tests pin the guard itself — the failure mode they
+exist for is a well-meaning future edit that re-derives a target and
+silently breaks cross-round comparability.
+"""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO) if REPO not in sys.path else None
+
+import bench  # noqa: E402
+
+
+def test_every_rung_has_a_frozen_hash():
+    assert set(bench.FROZEN_HASHES) == set(bench.RUNG_CONTRACTS)
+    for rung in bench.RUNG_CONTRACTS:
+        bench._check_frozen(rung)  # must not raise while contracts are intact
+
+
+def test_contract_drift_refuses_to_emit(monkeypatch):
+    """Editing any contract field without updating the freeze must raise."""
+    drifted = dict(bench.RUNG_CONTRACTS["attn"], target_tflops=42.0)
+    monkeypatch.setitem(bench.RUNG_CONTRACTS, "attn", drifted)
+    with pytest.raises(RuntimeError, match="frozen"):
+        bench._check_frozen("attn")
+
+
+def test_rung_result_guards_before_measuring(monkeypatch):
+    """_rung_result must consult the freeze before any measurement work:
+    the guard raises even with every backend argument stubbed to None."""
+    drifted = dict(bench.RUNG_CONTRACTS["zero2"])
+    drifted["baseline_tokens_per_sec_chip"] = 1.0
+    monkeypatch.setitem(bench.RUNG_CONTRACTS, "zero2", drifted)
+    with pytest.raises(RuntimeError, match="frozen"):
+        bench._rung_result("zero2", None, None, None, None, None, "cpu", 1, [1], 1, 1, 1, "")
+
+
+def test_baseline_md_mirrors_frozen_hashes():
+    """BASELINE.md's human-readable freeze table must match the code."""
+    with open(os.path.join(REPO, "BASELINE.md")) as f:
+        text = f.read()
+    for rung, h in bench.FROZEN_HASHES.items():
+        assert f"| `{rung}` | `{h}` |" in text, f"BASELINE.md freeze row missing/stale for {rung}"
+
+
+def test_freeze_table_roundtrip():
+    """freeze_table() (the documented regeneration command) emits exactly
+    the rows BASELINE.md carries."""
+    rows = bench.freeze_table().splitlines()
+    assert rows == [f"| `{r}` | `{bench._contract_hash(r)}` |" for r in bench.RUNG_CONTRACTS]
