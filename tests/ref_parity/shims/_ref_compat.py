@@ -1,0 +1,31 @@
+"""Compatibility patches so reference DeepSpeed 0.14.3 (read-only at
+/root/reference) imports and trains on CPU under the installed torch 2.13
+/ numpy 2.x. Import this BEFORE `import deepspeed`, then call
+``patch_deepspeed()`` right after.
+"""
+import numpy as _np
+import torch.distributed.elastic.agent.server.api as _api
+from torch.distributed.elastic.utils.distributed import get_socket_with_port as _gswp
+
+# torch 2.13 renamed the private elastic-agent helper the reference's
+# elasticity module imports at package-import time
+if not hasattr(_api, "_get_socket_with_port"):
+    _api._get_socket_with_port = _gswp
+
+# numpy 2.x removed the BUFSIZE constant used by the reference autotuner
+if not hasattr(_np, "BUFSIZE"):
+    _np.BUFSIZE = 8192
+
+
+def patch_deepspeed():
+    """Post-import patches: call after `import deepspeed`."""
+    import importlib
+    import sys
+
+    # NB: deepspeed.comm/__init__ star-imports `torch` over the submodule
+    # attribute, so resolve the real deepspeed/comm/torch.py via sys.modules
+    importlib.import_module("deepspeed.comm.torch")
+    _dct = sys.modules["deepspeed.comm.torch"]
+    # the SHM inference-allreduce op wants a JIT build (ninja python pkg
+    # absent in this image); training collectives ride gloo, so skip it
+    _dct.build_shm_op = lambda: None

@@ -1,0 +1,10 @@
+"""Minimal stand-in for py-cpuinfo (not installed in this image).
+
+Reference DeepSpeed (`/root/reference/deepspeed/ops/adam/cpu_adam.py:7`)
+imports it only to pick cpu-adam ISA flags; the parity runner never JIT
+-builds that op, so static generic values suffice.
+"""
+
+
+def get_cpu_info():
+    return {"arch": "X86_64", "vendor_id_raw": "GenuineIntel", "flags": []}

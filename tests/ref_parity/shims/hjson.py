@@ -1,0 +1,7 @@
+"""hjson stand-in (not installed in this image).
+
+Reference DeepSpeed (`/root/reference/deepspeed/runtime/config.py:12`)
+parses its config files with hjson; the parity runner feeds it strict
+JSON / python dicts only, so the stdlib json API is sufficient.
+"""
+from json import load, loads, dump, dumps  # noqa: F401

@@ -1,0 +1,179 @@
+"""Loss-curve parity against the INSTALLED reference DeepSpeed.
+
+The north star (BASELINE.md:16) asks for "an identical loss curve", and
+every other oracle in this suite re-implements the reference's math;
+this one runs the real thing: the same tiny HF GPT-2 checkpoint is
+trained (a) by reference DeepSpeed 0.14.3 (`/root/reference`) on
+CPU/gloo via ``tests/ref_parity/ref_train.py`` subprocesses, and (b) by
+``deepspeed_tpu.initialize`` on the CPU backend — same init, same data
+order, same plain-Adam hyperparameters, same shifted-mean-CE loss — and
+the per-step trajectories are asserted close.
+
+What this catches that the torch-AdamW re-implementation oracles
+(test_adam_oracle.py) cannot: drift anywhere in the *composition* —
+loss definition, grad averaging across data-parallel ranks, optimizer
+sequencing, precision policy — because the reference side is the
+reference's own engine loop (engine.py forward/backward/step), not a
+transcription.
+
+Reference harness analogue: ``tests/unit/common.py:113`` (DistributedTest
+over gloo); entry ``deepspeed/__init__.py:70``.
+
+Tier: nightly (subprocess trainings + a jit compile per leg).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REF_TRAIN = os.path.join(REPO, "tests", "ref_parity", "ref_train.py")
+REFERENCE_AVAILABLE = os.path.isdir("/root/reference/deepspeed")
+
+pytestmark = [
+    pytest.mark.nightly,
+    pytest.mark.skipif(not REFERENCE_AVAILABLE, reason="reference DeepSpeed tree not present"),
+]
+
+# one shared recipe so both sides (and all legs) agree by construction
+STEPS = 200
+GLOBAL_BATCH = 8
+SEQ = 64
+LR = 1e-3
+DATA_SEED = 1234
+N_BATCHES = 8  # step i trains on batch i % N_BATCHES: a finite dataset the
+#                model can memorize, so the curve actually descends
+
+
+def make_batches(vocab: int) -> np.ndarray:
+    """The shared (N_BATCHES, GLOBAL_BATCH, SEQ) token stream."""
+    rng = np.random.default_rng(DATA_SEED)
+    return rng.integers(0, vocab, size=(N_BATCHES, GLOBAL_BATCH, SEQ))
+
+
+@pytest.fixture(scope="module")
+def gpt2_ckpt(tmp_path_factory):
+    """A seeded tiny HF GPT-2 checkpoint both frameworks load.
+
+    Dropout zeroed: parity needs a deterministic forward; the reference
+    engine runs the module in train() mode.
+    """
+    import torch
+    import transformers
+
+    d = tmp_path_factory.mktemp("ref_parity_ckpt")
+    torch.manual_seed(7)
+    cfg = transformers.GPT2Config(vocab_size=256, n_positions=128, n_embd=64, n_layer=2,
+                                  n_head=4, resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    transformers.GPT2LMHeadModel(cfg).save_pretrained(d, safe_serialization=True)
+    return str(d)
+
+
+def _run_reference(ckpt, tmp_path, dtype, zero_stage, world):
+    """Train via the reference engine in `world` gloo subprocesses; return
+    the global mean-loss trajectory (equal rank batches -> rank average)."""
+    from dist_utils import free_port
+
+    spec = {"ckpt_dir": ckpt, "steps": STEPS, "dtype": dtype, "zero_stage": zero_stage,
+            "lr": LR, "global_batch": GLOBAL_BATCH, "seq_len": SEQ, "data_seed": DATA_SEED,
+            "n_batches": N_BATCHES,
+            "out_path": str(tmp_path / f"ref_{dtype}_z{zero_stage}_w{world}")}
+    spec_path = tmp_path / "spec.json"
+    spec_path.write_text(json.dumps(spec))
+    port = free_port()
+    procs = []
+    for r in range(world):
+        env = dict(os.environ)
+        env.update({"RANK": str(r), "WORLD_SIZE": str(world), "LOCAL_RANK": str(r),
+                    "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port),
+                    # keep the reference torch run off the TPU tunnel and quiet
+                    "DS_ACCELERATOR": "cpu", "CUDA_VISIBLE_DEVICES": ""})
+        procs.append(subprocess.Popen([sys.executable, REF_TRAIN, str(spec_path)],
+                                      stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env))
+    outs = [p.communicate(timeout=900)[0].decode(errors="replace") for p in procs]
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"reference trainer rank failed:\n{out[-4000:]}"
+    per_rank = []
+    for r in range(world):
+        with open(f"{spec['out_path']}.rank{r}") as f:
+            per_rank.append(json.load(f)["losses"])
+    return np.mean(np.asarray(per_rank), axis=0)
+
+
+def _run_native(ckpt, dtype, zero_stage):
+    """Train the converted checkpoint through deepspeed_tpu on the default
+    (8-virtual-device data-parallel) mesh; returns the per-step global mean
+    loss. The dp degree is immaterial to the math — the loss/grad are means
+    over the same 8-row global batch at any sharding — so one native run is
+    the oracle for every reference world size."""
+    import jax
+
+    import deepspeed_tpu
+    from deepspeed_tpu.module_inject import load_hf_checkpoint
+
+    model, params = load_hf_checkpoint(ckpt)
+    n_dev = jax.device_count()
+    assert GLOBAL_BATCH % n_dev == 0
+    config = {
+        "train_micro_batch_size_per_gpu": GLOBAL_BATCH // n_dev,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "adam",
+                      "params": {"lr": LR, "betas": [0.9, 0.999], "eps": 1e-8,
+                                 "weight_decay": 0.0, "adam_w_mode": False}},
+        "zero_optimization": {"stage": zero_stage},
+        "bf16": {"enabled": dtype == "bf16"},
+        "steps_per_print": 1 << 30,
+    }
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
+
+    data = make_batches(vocab=256)
+
+    def batches():
+        step = 0
+        while True:
+            yield {"input_ids": data[step % N_BATCHES].astype(np.int32)}
+            step += 1
+
+    it = batches()
+    return np.asarray([float(engine.train_batch(it)) for _ in range(STEPS)])
+
+
+def _assert_trajectories_close(ref, native, early_tol, late_tol):
+    """Per-step closeness with a tolerance that widens after step 50:
+    identical math still accumulates reduction-order rounding drift."""
+    assert ref.shape == native.shape == (STEPS,)
+    delta = np.abs(ref - native)
+    head, tail = delta[:50], delta[50:]
+    print(f"[ref-parity] max|d| head={head.max():.2e} tail={tail.max():.2e} "
+          f"final ref={ref[-1]:.4f} native={native[-1]:.4f}")
+    assert head.max() < early_tol, \
+        f"early trajectory diverged: max |d|={head.max():.3e} at step {head.argmax()} (tol {early_tol})"
+    assert tail.max() < late_tol, \
+        f"late trajectory diverged: max |d|={tail.max():.3e} at step {50 + tail.argmax()} (tol {late_tol})"
+    # both must actually have trained (memorizing random tokens drops CE)
+    assert ref[:5].mean() - ref[-5:].mean() > 0.05
+    assert native[:5].mean() - native[-5:].mean() > 0.05
+
+
+# tolerances: ~30-50x over the measured drift (fp32 max|d| head 1.2e-6 /
+# tail 1.6e-5; bf16 6.7e-4 / 6.1e-2 — recorded 2026-08-01) so the bands
+# stay tight enough to catch optimizer/precision drift yet absorb
+# platform-dependent reduction ordering
+@pytest.mark.parametrize("dtype,zero_stage,world,early_tol,late_tol", [
+    ("fp32", 0, 1, 5e-5, 5e-4),
+    ("fp32", 0, 2, 5e-5, 5e-4),
+    ("fp32", 2, 2, 5e-5, 5e-4),
+    # bf16 matmul rounding differs between oneDNN and XLA CPU emulation;
+    # the band is correspondingly wider but still curve-shaped-tight
+    ("bf16", 1, 1, 5e-3, 1e-1),
+    ("bf16", 1, 2, 5e-3, 1e-1),
+], ids=["fp32-z0-w1", "fp32-z0-w2", "fp32-z2-w2", "bf16-z1-w1", "bf16-z1-w2"])
+def test_loss_curve_matches_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, world,
+                                      early_tol, late_tol):
+    ref = _run_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, world)
+    native = _run_native(gpt2_ckpt, dtype, zero_stage)
+    _assert_trajectories_close(ref, native, early_tol, late_tol)
