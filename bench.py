@@ -85,6 +85,14 @@ RUNG_CONTRACTS = {
         "accounting": "same HBM-bound derivation as decode plus scheduling overhead",
         "baseline_tokens_per_sec_chip": 25000.0,
     },
+    "serve_sla": {
+        "model": "gpt2-124M bf16, v2 ragged engine under Poisson open-loop load",
+        "measure": "effective tokens/s at SLA: best rate row with <=1% SLA misses "
+                   "(TTFT <= 1 s AND per-token <= 250 ms, the FastGen streaming standard)",
+        "workload": "32 requests, prompt 64..128, 128 new tokens, arrival sweep [2,4,8,16] req/s",
+        "accounting": "same HBM-bound 25k tok/s/chip denominator as serve; full table -> BENCH_SLA.json",
+        "baseline_tokens_per_sec_chip": 25000.0,
+    },
     "attn": {
         "shape": "B2 S4096 H32 KVH4 D128 causal, full fwd+bwd (grads wrt q,k,v)",
         "measure": "useful TF/s of the winning attention impl",
@@ -112,6 +120,7 @@ FROZEN_HASHES = {
     "zero3": "68f02dbbe3404e65",
     "decode": "c9c5e4e408065244",
     "serve": "e39f632039a0821a",
+    "serve_sla": "4ef79dd1d8c8501c",
     "attn": "779084b20083fd56",
     "attn_d64": "73ea8908662973d7",
     "longctx": "d12d5cc4417623bf",
@@ -202,6 +211,44 @@ def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
     t2 = time.perf_counter()
     decode_dt = max((t2 - t1) - (t1 - t0), 1e-9)  # time for the extra (N - N/2) steps
     return batch * (new_tokens - half) / decode_dt
+
+
+def run_serve_sla(jax, jnp, np, cfg_model, platform):
+    """Throughput–latency sweep (contract: RUNG_CONTRACTS['serve_sla']).
+
+    Writes the full table to BENCH_SLA.json; returns (effective tokens/s
+    at SLA, table). The reference publishes exactly this table shape for
+    FastGen (blogs/deepspeed-fastgen/README.md:139)."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, LoadSpec, RaggedBatchConfig,
+                                            RaggedInferenceEngineConfig, effective_throughput_at_sla,
+                                            sweep)
+    from deepspeed_tpu.models import CausalLM
+
+    if platform == "tpu":
+        n_req, plo, phi, new_toks, rates = 32, 64, 128, 128, [2.0, 4.0, 8.0, 16.0]
+    else:
+        n_req, plo, phi, new_toks, rates = 4, 4, 12, 8, [20.0, 50.0]
+    model = CausalLM(cfg_model)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    max_ctx = min(cfg_model.max_seq_len, phi + new_toks + 64)
+    smc = RaggedBatchConfig(max_context=max_ctx)
+    smc.num_kv_blocks = n_req * (-(-max_ctx // smc.kv_block_size)) + 8
+    eng = InferenceEngineV2(model, params,
+                            RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16"))
+    base = LoadSpec(n_requests=n_req, prompt_len_range=(plo, phi), max_new_tokens=new_toks,
+                    vocab_size=cfg_model.vocab_size)
+    # compile outside the timed sweep: one untimed saturating run over the
+    # SAME spec hits every prefill bucket / decode-batch / burst shape the
+    # measured rows will use (a cold jit inside a row reads as a 10s+ TTFT)
+    from deepspeed_tpu.inference.v2 import run_load
+    run_load(eng, LoadSpec(n_requests=n_req, prompt_len_range=(plo, phi),
+                           max_new_tokens=new_toks, vocab_size=cfg_model.vocab_size,
+                           arrival_rate=1e9))
+    rows = sweep(eng, rates=rates, base=base)
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_SLA.json")
+    with open(path, "w") as f:
+        json.dump({"platform": platform, "rows": rows}, f, indent=1)
+    return effective_throughput_at_sla(rows), rows
 
 
 def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
@@ -383,6 +430,18 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             "unit": "tokens/s/chip",
             "vs_baseline": round(tps / baseline, 4),
         }
+    if rung == "serve_sla":
+        eff, rows = run_serve_sla(jax, jnp, np, cfg_model, platform)
+        baseline = RUNG_CONTRACTS["serve_sla"]["baseline_tokens_per_sec_chip"]
+        return {
+            "metric": f"gpt2-125m_bf16_serve_effective_tokens_per_sec_at_sla{tag}",
+            "value": round(eff, 1),
+            "unit": "tokens/s/chip",
+            # the SLA headline only means something against the TPU-derived
+            # HBM bound; CPU rows keep the absolute number + table only
+            "vs_baseline": round(eff / baseline, 4) if platform == "tpu" else None,
+            "rows": rows,
+        }
     if rung in ("attn", "attn_d64", "longctx"):
         ab = {"attn": run_attention_rep, "attn_d64": run_attention_d64, "longctx": run_longctx_ab}[rung]
         tfs = ab(jax, jnp, np, platform, iters=max(iters, 3) if rung != "longctx" else 10)
@@ -447,7 +506,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
 
 def main():
     rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
-    known = ("zero2", "zero3", "decode", "serve", "attn", "attn_d64", "longctx")
+    known = ("zero2", "zero3", "decode", "serve", "serve_sla", "attn", "attn_d64", "longctx")
     if rung not in known:
         print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected {' | '.join(known)}", file=sys.stderr)
         return 1

@@ -12,6 +12,7 @@ CUDA ragged kernel suite.
 from .ragged import BlockedAllocator, DSSequenceDescriptor, DSStateManager, RaggedBatchConfig
 from .scheduler import RaggedRequest, RaggedBatchScheduler
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+from .sla import LoadSpec, RequestStat, effective_throughput_at_sla, run_load, summarize, sweep
 
 __all__ = [
     "BlockedAllocator",
@@ -22,4 +23,10 @@ __all__ = [
     "RaggedBatchScheduler",
     "InferenceEngineV2",
     "RaggedInferenceEngineConfig",
+    "LoadSpec",
+    "RequestStat",
+    "run_load",
+    "summarize",
+    "sweep",
+    "effective_throughput_at_sla",
 ]

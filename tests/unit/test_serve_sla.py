@@ -1,0 +1,120 @@
+"""Serving SLA harness (`inference/v2/sla.py`).
+
+The reference's serving bar is a throughput–latency table + an
+"effective throughput under SLA" headline (fastgen blog README:139,163);
+these tests pin (a) the load loop's token-level correctness against the
+engine's own batch generate, (b) timestamp sanity, (c) the SLA math on
+synthetic stats, so the on-chip capture session only has to *run* it.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import InferenceEngineV2
+from deepspeed_tpu.inference.v2.sla import (LoadSpec, RequestStat, effective_throughput_at_sla,
+                                            run_load, summarize, sweep)
+from tests.unit.test_inference_v2 import v2_setup  # noqa: F401  (module-scoped fixture)
+
+
+def _mk_engine(v2_setup, burst=0):
+    model, params, cfg = v2_setup
+    return InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=burst))
+
+
+def _replay_prompts(spec):
+    """The exact prompt set run_load derives from the spec's rng."""
+    rng = np.random.default_rng(spec.seed)
+    _ = np.cumsum(rng.exponential(1.0 / spec.arrival_rate, spec.n_requests))
+    lo, hi = spec.prompt_len_range
+    lens = rng.integers(lo, hi + 1, spec.n_requests)
+    return [rng.integers(0, spec.vocab_size, size=int(l)).tolist() for l in lens]
+
+
+class TestRunLoad:
+
+    def test_tokens_match_batch_generate(self, v2_setup):
+        """Open-loop scheduling must not change greedy results: every
+        request's tokens equal the engine's own generate() output."""
+        spec = LoadSpec(n_requests=6, arrival_rate=200.0, prompt_len_range=(4, 10),
+                        max_new_tokens=8, vocab_size=128, seed=3)
+        eng = _mk_engine(v2_setup)
+        stats = run_load(eng, spec)
+        prompts = _replay_prompts(spec)
+        ref = _mk_engine(v2_setup).generate(prompts, max_new_tokens=8)
+        assert [s.tokens for s in stats] == ref
+
+    def test_tokens_match_with_bursts(self, v2_setup):
+        spec = LoadSpec(n_requests=4, arrival_rate=500.0, prompt_len_range=(4, 8),
+                        max_new_tokens=12, vocab_size=128, seed=5)
+        eng = _mk_engine(v2_setup, burst=8)
+        stats = run_load(eng, spec)
+        prompts = _replay_prompts(spec)
+        ref = _mk_engine(v2_setup).generate(prompts, max_new_tokens=12)
+        assert [s.tokens for s in stats] == ref
+
+    def test_timestamps_sane(self, v2_setup):
+        spec = LoadSpec(n_requests=5, arrival_rate=50.0, prompt_len_range=(4, 8),
+                        max_new_tokens=4, vocab_size=128, seed=1)
+        stats = run_load(_mk_engine(v2_setup), spec)
+        for s in stats:
+            assert s.admitted >= s.arrival
+            assert s.first_token >= s.admitted
+            assert s.done >= s.first_token
+            assert s.n_new == spec.max_new_tokens
+            assert s.ttft > 0.0 and s.tpot >= 0.0
+
+    def test_kv_pool_drains(self, v2_setup):
+        eng = _mk_engine(v2_setup)
+        free0 = eng.state.free_blocks
+        run_load(eng, LoadSpec(n_requests=4, arrival_rate=100.0, prompt_len_range=(4, 8),
+                               max_new_tokens=4, vocab_size=128, seed=2))
+        assert eng.state.free_blocks == free0
+
+
+def _stat(arrival, ttft, tpot, n_new=8):
+    first = arrival + ttft
+    return RequestStat(uid=0, prompt_len=8, arrival=arrival, admitted=arrival,
+                       first_token=first, done=first + tpot * (n_new - 1), n_new=n_new)
+
+
+class TestSummarize:
+
+    def test_sla_miss_accounting(self):
+        stats = [
+            _stat(0.0, ttft=0.1, tpot=0.01),   # meets both
+            _stat(0.5, ttft=2.0, tpot=0.01),   # misses TTFT
+            _stat(1.0, ttft=0.2, tpot=0.50),   # misses TPOT
+            _stat(1.5, ttft=0.3, tpot=0.02),   # meets both
+        ]
+        out = summarize(stats, ttft_sla=1.0, tpot_sla=0.25)
+        assert out["n_requests"] == 4
+        assert out["sla_miss_frac"] == 0.5
+        assert out["ttft_p50_s"] == pytest.approx(0.25, abs=1e-6)
+
+    def test_throughput_is_span_based(self):
+        # 2 requests x 8 tokens over a 4 s span (first arrival 0, last done 4)
+        stats = [_stat(0.0, ttft=0.5, tpot=0.5), _stat(0.0, ttft=0.5, tpot=0.5)]
+        out = summarize(stats)
+        assert out["tokens_per_sec"] == pytest.approx(16 / 4.0, rel=1e-3)
+
+    def test_effective_throughput_at_sla(self):
+        rows = [
+            {"tokens_per_sec": 100.0, "sla_miss_frac": 0.0},
+            {"tokens_per_sec": 180.0, "sla_miss_frac": 0.01},
+            {"tokens_per_sec": 250.0, "sla_miss_frac": 0.30},  # over the line
+        ]
+        assert effective_throughput_at_sla(rows) == 180.0
+        assert effective_throughput_at_sla(rows, max_miss=0.5) == 250.0
+        assert effective_throughput_at_sla(rows[2:]) == 0.0
+
+
+def test_sweep_shape(v2_setup):
+    eng = _mk_engine(v2_setup)
+    base = LoadSpec(n_requests=3, prompt_len_range=(4, 6), max_new_tokens=3,
+                    vocab_size=128, seed=9)
+    rows = sweep(eng, rates=[50.0, 200.0], base=base)
+    assert [r["arrival_rate"] for r in rows] == [50.0, 200.0]
+    for r in rows:
+        assert {"tokens_per_sec", "ttft_p95_s", "tpot_p50_s", "sla_miss_frac"} <= set(r)
