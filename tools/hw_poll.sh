@@ -10,7 +10,7 @@ while true; do
 import jax, jax.numpy as jnp
 print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$STATUS" 2>&1; then
         echo "[poll $(date +%H:%M:%S)] TUNNEL ALIVE - starting hw_session" >> "$STATUS"
-        bash tools/hw_session.sh hw_session_r4.log
+        bash tools/hw_session.sh hw_session_r5.log
         echo "[poll $(date +%H:%M:%S)] hw_session finished rc=$?" >> "$STATUS"
         exit 0
     fi

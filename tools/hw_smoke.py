@@ -172,8 +172,12 @@ def _ops():
                 err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
                 assert err < 0.25, (bits, m, err)
 
-    return {"flash": flash, "sparse": sparse, "paged": paged, "norms": norms,
-            "optimizers": optimizers, "quant": quant, "qmm": qmm, "serve": serve}
+    # order = priority: the round-4 rewrites that have never met real
+    # Mosaic (GQA-collapsed flash fwd+bwd, partitioned qmm, sampled-burst
+    # serve) run FIRST — chip windows die; spend the first minutes on the
+    # kernels with zero hardware evidence (VERDICT r5 #1)
+    return {"flash": flash, "qmm": qmm, "serve": serve, "paged": paged,
+            "sparse": sparse, "norms": norms, "optimizers": optimizers, "quant": quant}
 
 
 def main():
