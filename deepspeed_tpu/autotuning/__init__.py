@@ -1,4 +1,6 @@
 from .autotuner import Autotuner, autotune
+from .scheduler import TrialScheduler, ssh_prefixes_from_hostfile
 from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
-__all__ = ["Autotuner", "autotune", "GridSearchTuner", "RandomTuner", "ModelBasedTuner"]
+__all__ = ["Autotuner", "autotune", "GridSearchTuner", "RandomTuner", "ModelBasedTuner",
+           "TrialScheduler", "ssh_prefixes_from_hostfile"]

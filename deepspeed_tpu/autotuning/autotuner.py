@@ -25,6 +25,79 @@ TUNERS = {"gridsearch": GridSearchTuner, "random": RandomTuner, "model_based": M
 DEFAULT_TUNING_SPACE_ZERO_STAGES = [0, 1, 2, 3]
 
 
+def run_trial(model, params, config: Dict, batches: Sequence, steps_per_trial: int,
+              warmup_steps: int, metric: str) -> Tuple[float, Optional[int]]:
+    """The trial loop itself: build an engine under ``config``, run
+    warmup + timed steps, return (metric value, peak memory bytes).
+    Raises on failure — callers decide the failure policy. Shared by the
+    in-process path and the subprocess ``trial_runner``."""
+    import jax
+    import jax.numpy as jnp
+
+    import deepspeed_tpu
+
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
+    mb = config.get("train_micro_batch_size_per_gpu", 1)
+    dp = engine.topology.data_parallel_size
+
+    def batch_at(i):
+        b = batches[i % len(batches)]
+        leaves = jax.tree_util.tree_leaves(b)
+        need = mb * dp
+        if leaves and leaves[0].shape[0] != need:
+            reps = -(-need // leaves[0].shape[0])
+            return jax.tree_util.tree_map(lambda x: np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need], b)
+        return b
+
+    for i in range(warmup_steps):
+        engine.forward(batch_at(i))
+        engine.backward()
+        engine.step()
+    t0 = time.perf_counter()
+    for i in range(steps_per_trial):
+        engine.forward(batch_at(warmup_steps + i))
+        engine.backward()
+        engine.step()
+    (jnp.zeros(()) + 0).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    mem_bytes = measure_memory(engine, batch_at(0))
+    samples = steps_per_trial * mb * dp * engine.gradient_accumulation_steps
+    val = -dt / steps_per_trial if metric == "latency" else samples / dt
+    return val, mem_bytes
+
+
+def measure_memory(engine, batch) -> Optional[int]:
+    """Peak per-chip memory of the trial. Prefers the backend's live
+    allocator stats (true runtime peak, zero extra compilation);
+    falls back to XLA buffer-assignment totals of the train step
+    (pays one re-lower, but lower()/compile() hit the jit cache's
+    already-built executable on most backends)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("peak_bytes_in_use"):
+            return int(stats["peak_bytes_in_use"])
+    except Exception:
+        pass
+    try:
+        fwd_bwd = engine._fwd_bwd
+        if not hasattr(fwd_bwd, "lower"):
+            return None
+        compiled = fwd_bwd.lower(engine.params, engine._put_batch(batch), 0, 1.0).compile()
+        mem = compiled.memory_analysis()
+        if mem is None:
+            return None
+        total = 0
+        for attr in ("argument_size_in_bytes", "output_size_in_bytes", "temp_size_in_bytes",
+                     "generated_code_size_in_bytes"):
+            total += int(getattr(mem, attr, 0) or 0)
+        return total or None
+    except Exception:
+        return None
+
+
 def _deep_update(base: Dict, override: Dict) -> Dict:
     out = json.loads(json.dumps(base))
     for k, v in override.items():
@@ -44,9 +117,18 @@ class Autotuner:
                  params_factory: Optional[Callable[[], Any]] = None,
                  metric: str = "throughput",
                  steps_per_trial: int = 4,
-                 warmup_steps: int = 1):
+                 warmup_steps: int = 1,
+                 model_spec=None):
         """``model_factory()`` returns a fresh model; ``train_batches`` is a
-        list of batches each trial iterates over (repeated as needed)."""
+        list of batches each trial iterates over (repeated as needed).
+
+        ``model_spec`` (TransformerConfig kwargs dict, or an import path
+        ``"pkg.module:factory"``) enables SUBPROCESS trial isolation —
+        ``autotuning: {"trial_isolation": true}`` — because a live
+        factory callable cannot cross a process boundary. With
+        ``"parallel_trials": N`` grid/random searches additionally fan
+        N trials over worker slots (scheduler.py), including remote
+        slots via ``"hostfile"``."""
         self.model_factory = model_factory
         self.params_factory = params_factory
         self.base_config = dict(base_config)
@@ -55,6 +137,7 @@ class Autotuner:
         self.metric = self.at_cfg.get("metric", metric)
         self.steps_per_trial = steps_per_trial
         self.warmup_steps = warmup_steps
+        self.model_spec = model_spec
         self.records: List[Dict] = []
 
     # ------------------------------------------------------------------
@@ -95,120 +178,127 @@ class Autotuner:
         failure (the reference's failed-experiment path)."""
         import jax
 
-        import deepspeed_tpu
-
         config = _deep_update(self.base_config, exp)
         config.pop("autotuning", None)
-        engine = None
         self._last_memory_bytes = None
         try:
             model = self.model_factory()
             params = self.params_factory() if self.params_factory else model.init(
                 jax.random.PRNGKey(0), self.train_batches[0])
-            engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
-            mb = config.get("train_micro_batch_size_per_gpu", 1)
-            dp = engine.topology.data_parallel_size
-
-            def batch_at(i):
-                b = self.train_batches[i % len(self.train_batches)]
-                leaves = jax.tree_util.tree_leaves(b)
-                need = mb * dp
-                if leaves and leaves[0].shape[0] != need:
-                    reps = -(-need // leaves[0].shape[0])
-                    return jax.tree_util.tree_map(lambda x: np.tile(x, (reps,) + (1,) * (x.ndim - 1))[:need], b)
-                return b
-
-            for i in range(self.warmup_steps):
-                engine.forward(batch_at(i))
-                engine.backward()
-                engine.step()
-            t0 = time.perf_counter()
-            for i in range(self.steps_per_trial):
-                engine.forward(batch_at(self.warmup_steps + i))
-                engine.backward()
-                engine.step()
-            import jax.numpy as jnp
-
-            (jnp.zeros(()) + 0).block_until_ready()
-            dt = time.perf_counter() - t0
-
-            # memory audit (reference gap: throughput-only tuning can pick
-            # a config one batch from OOM): compiled peak bytes per chip,
-            # recorded and optionally budget-gated
-            mem_bytes = self._measure_memory(engine, batch_at(0))
+            val, mem_bytes = run_trial(model, params, config, self.train_batches,
+                                       self.steps_per_trial, self.warmup_steps, self.metric)
             self._last_memory_bytes = mem_bytes
-            budget_gb = self.at_cfg.get("max_memory_per_chip_gb")
-            if budget_gb and mem_bytes is None:
-                logger.warning(f"autotuning experiment {exp}: memory budget set but peak memory is "
-                               "unmeasurable for this config (custom fwd_bwd path) — budget NOT enforced")
-            if mem_bytes is not None and budget_gb and mem_bytes > float(budget_gb) * (1 << 30):
-                logger.warning(f"autotuning experiment {exp} over memory budget: "
-                               f"{mem_bytes / (1 << 30):.2f} GiB > {budget_gb} GiB")
+            if self._over_memory_budget(exp, mem_bytes):
                 return None
-
-            samples = self.steps_per_trial * mb * dp * engine.gradient_accumulation_steps
-            if self.metric == "latency":
-                return -dt / self.steps_per_trial
-            return samples / dt  # throughput (samples/sec); also the 'flops' proxy
+            return val
         except Exception as e:  # noqa: BLE001 — OOM/compile failures score None
             logger.warning(f"autotuning experiment {exp} failed: {type(e).__name__}: {e}")
             return None
         finally:
-            del engine
             gc.collect()
 
-    def _measure_memory(self, engine, batch) -> Optional[int]:
-        """Peak per-chip memory of the trial. Prefers the backend's live
-        allocator stats (true runtime peak, zero extra compilation);
-        falls back to XLA buffer-assignment totals of the train step
-        (pays one re-lower, but lower()/compile() hit the jit cache's
-        already-built executable on most backends)."""
-        import jax
+    def _over_memory_budget(self, exp: Dict, mem_bytes: Optional[int]) -> bool:
+        """Memory audit (reference gap: throughput-only tuning can pick a
+        config one batch from OOM): budget-gate the measured peak."""
+        budget_gb = self.at_cfg.get("max_memory_per_chip_gb")
+        if budget_gb and mem_bytes is None:
+            logger.warning(f"autotuning experiment {exp}: memory budget set but peak memory is "
+                           "unmeasurable for this config (custom fwd_bwd path) — budget NOT enforced")
+        if mem_bytes is not None and budget_gb and mem_bytes > float(budget_gb) * (1 << 30):
+            logger.warning(f"autotuning experiment {exp} over memory budget: "
+                           f"{mem_bytes / (1 << 30):.2f} GiB > {budget_gb} GiB")
+            return True
+        return False
 
-        try:
-            stats = jax.local_devices()[0].memory_stats()
-            if stats and stats.get("peak_bytes_in_use"):
-                return int(stats["peak_bytes_in_use"])
-        except Exception:
-            pass
-        try:
-            fwd_bwd = engine._fwd_bwd
-            if not hasattr(fwd_bwd, "lower"):
-                return None
-            compiled = fwd_bwd.lower(engine.params, engine._put_batch(batch), 0, 1.0).compile()
-            mem = compiled.memory_analysis()
-            if mem is None:
-                return None
-            total = 0
-            for attr in ("argument_size_in_bytes", "output_size_in_bytes", "temp_size_in_bytes",
-                         "generated_code_size_in_bytes"):
-                total += int(getattr(mem, attr, 0) or 0)
-            return total or None
-        except Exception:
-            return None
+    # ---------------------------------------------------------- isolation
+    def _trial_spec(self, exp: Dict, batches_npz: str) -> Dict:
+        import dataclasses
+
+        model_ref = self.model_spec
+        if dataclasses.is_dataclass(model_ref):
+            model_ref = dataclasses.asdict(model_ref)
+            # dtype is a jax type, not JSON-able; the runner's
+            # TransformerConfig default reapplies it
+            model_ref.pop("dtype", None)
+        config = _deep_update(self.base_config, exp)
+        config.pop("autotuning", None)
+        return {"config": config, "model": model_ref, "batches_npz": batches_npz,
+                "steps_per_trial": self.steps_per_trial, "warmup_steps": self.warmup_steps,
+                "metric": self.metric}
+
+    def _make_scheduler(self):
+        from .scheduler import TrialScheduler, ssh_prefixes_from_hostfile
+
+        prefixes = None
+        if self.at_cfg.get("hostfile"):
+            prefixes = ssh_prefixes_from_hostfile(self.at_cfg["hostfile"])
+        return TrialScheduler(n_workers=int(self.at_cfg.get("parallel_trials", 1)),
+                              launch_prefixes=prefixes,
+                              timeout_s=float(self.at_cfg.get("trial_timeout_s", 600)))
+
+    def _dump_batches(self, d: str) -> str:
+        path = os.path.join(d, "batches.npz")
+        stacks = {k: np.stack([np.asarray(b[k]) for b in self.train_batches])
+                  for k in self.train_batches[0]}
+        np.savez(path, **stacks)
+        return path
 
     def tune(self, stages: Optional[List[int]] = None, micro_batches: Optional[List[int]] = None) -> Dict:
-        """Run the search; returns the best merged config (reference :404)."""
+        """Run the search; returns the best merged config (reference :404).
+
+        ``autotuning.trial_isolation`` runs each trial in a subprocess via
+        ``trial_runner`` (crash/OOM-proof); with ``parallel_trials`` > 1,
+        order-independent tuners (grid/random) fan trials over worker
+        slots (reference: scheduler.py resource manager)."""
         exps = self._generate_experiments(stages, micro_batches)
         tuner_type = self.at_cfg.get("tuner_type", "gridsearch")
         tuner: BaseTuner = TUNERS[tuner_type](exps, metric=self.metric)
         early_stop = self.at_cfg.get("tuner_early_stopping", 5)
         max_trials = self.at_cfg.get("tuner_num_trials", 50)
-        n_run = 0
-        while n_run < max_trials:
-            batch = tuner.next_batch(1)
-            if not batch:
-                break
-            exp = batch[0]
-            val = self.run_experiment(exp)
-            tuner.record(exp, val)
-            self.records.append({"exp": exp, self.metric: val,
-                                 "memory_bytes": getattr(self, "_last_memory_bytes", None)})
-            logger.info(f"autotuning [{n_run + 1}/{min(max_trials, len(exps))}] {exp} -> {val}")
-            n_run += 1
-            if tuner.should_stop(early_stop):
-                logger.info("autotuning early stop: no improvement")
-                break
+
+        isolated = bool(self.at_cfg.get("trial_isolation"))
+        if isolated and self.model_spec is None:
+            raise ValueError("autotuning.trial_isolation needs model_spec (a TransformerConfig "
+                             "or 'module:factory' import path) — live factories cannot cross "
+                             "the subprocess boundary")
+        n_workers = int(self.at_cfg.get("parallel_trials", 1))
+        parallel = isolated and n_workers > 1 and tuner_type in ("gridsearch", "random")
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="ds_autotune_") as tmp:
+            sched = self._make_scheduler() if isolated else None
+            npz = self._dump_batches(tmp) if isolated else None
+
+            def score(exp: Dict, result: Optional[Dict]) -> Tuple[Optional[float], Optional[int]]:
+                if result is None:
+                    return None, None
+                mem = result.get("memory_bytes")
+                if self._over_memory_budget(exp, mem):
+                    return None, mem
+                return result["value"], mem
+
+            n_run = 0
+            while n_run < max_trials:
+                batch = tuner.next_batch(n_workers if parallel else 1)
+                if not batch:
+                    break
+                batch = batch[:max_trials - n_run]
+                if isolated:
+                    results = sched.run_many([self._trial_spec(e, npz) for e in batch]) \
+                        if len(batch) > 1 else [(None, sched.run_one(self._trial_spec(batch[0], npz)))]
+                    scored = [(exp, *score(exp, res)) for exp, (_, res) in zip(batch, results)]
+                else:
+                    scored = [(batch[0], self.run_experiment(batch[0]),
+                               getattr(self, "_last_memory_bytes", None))]
+                for exp, val, mem in scored:
+                    tuner.record(exp, val)
+                    self.records.append({"exp": exp, self.metric: val, "memory_bytes": mem})
+                    n_run += 1
+                    logger.info(f"autotuning [{n_run}/{min(max_trials, len(exps))}] {exp} -> {val}")
+                if tuner.should_stop(early_stop):
+                    logger.info("autotuning early stop: no improvement")
+                    break
         best_exp, best_val = tuner.best()
         if best_exp is None:
             raise RuntimeError("autotuning: every experiment failed")

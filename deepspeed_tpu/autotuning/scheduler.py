@@ -1,0 +1,81 @@
+"""Trial scheduler: fan isolated autotuning trials over worker slots.
+
+Reference analogue: ``/root/reference/deepspeed/autotuning/scheduler.py``
+(``ResourceManager`` schedules experiment jobs over hosts). The
+TPU-native version orchestrates ``trial_runner`` subprocesses:
+
+- each worker slot runs one trial at a time in its own process
+  (isolation: a crash/OOM scores None, never kills the search);
+- a slot may carry a command *prefix* (e.g. ``["ssh", "host2"]`` or a
+  PDSH invocation built from ``launcher.runner.fetch_hostfile``) so
+  trials fan out across hosts of a pod the same way the reference's
+  resource manager uses its hostfile;
+- results are yielded as they complete; order-independent tuners
+  (grid/random) consume them concurrently, model-based tuning stays
+  sequential (it needs feedback between proposals).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import logger
+
+
+def ssh_prefixes_from_hostfile(hostfile_path: str) -> List[List[str]]:
+    """One ``ssh host`` prefix per hostfile entry (reference hostfile
+    format, parsed by the launcher's own reader)."""
+    from ..launcher.runner import fetch_hostfile
+
+    hosts = fetch_hostfile(hostfile_path)
+    if not hosts:
+        raise ValueError(f"no hosts parsed from {hostfile_path}")
+    return [["ssh", "-o", "StrictHostKeyChecking=no", h] for h in hosts]
+
+
+class TrialScheduler:
+    """Run trial specs concurrently in isolated subprocesses."""
+
+    def __init__(self, n_workers: int = 2, launch_prefixes: Optional[Sequence[Sequence[str]]] = None,
+                 timeout_s: float = 600.0, env: Optional[Dict[str, str]] = None):
+        self.n_workers = max(1, int(n_workers))
+        self.prefixes = [list(p) for p in launch_prefixes] if launch_prefixes else [[]]
+        self.timeout_s = float(timeout_s)
+        self.env = env
+
+    def run_one(self, spec: Dict, slot: int = 0) -> Optional[Dict]:
+        """Write the spec, launch the runner (with the slot's host
+        prefix), parse the result: {"value": float, "memory_bytes":
+        int|None}, or None on any failure/timeout/kill."""
+        with tempfile.TemporaryDirectory(prefix="ds_at_trial_") as d:
+            spec_path = os.path.join(d, "spec.json")
+            out_path = os.path.join(d, "out.json")
+            with open(spec_path, "w") as f:
+                json.dump(spec, f)
+            prefix = self.prefixes[slot % len(self.prefixes)]
+            cmd = prefix + [sys.executable, "-m", "deepspeed_tpu.autotuning.trial_runner",
+                            spec_path, out_path]
+            env = dict(os.environ, **(self.env or {}))
+            try:
+                proc = subprocess.run(cmd, capture_output=True, timeout=self.timeout_s, env=env)
+            except subprocess.TimeoutExpired:
+                logger.warning(f"autotuning trial timed out after {self.timeout_s:.0f}s: {cmd}")
+                return None
+            if proc.returncode != 0 or not os.path.exists(out_path):
+                tail = proc.stderr.decode(errors="replace")[-2000:]
+                logger.warning(f"autotuning trial failed rc={proc.returncode} "
+                               f"(signal-killed trials land here too):\n{tail}")
+                return None
+            with open(out_path) as f:
+                return json.load(f)
+
+    def run_many(self, specs: Sequence[Dict]) -> List[Tuple[Dict, Optional[Dict]]]:
+        """All specs over the worker pool; returns (spec, value) pairs in
+        submission order (results internally complete out of order)."""
+        with ThreadPoolExecutor(max_workers=self.n_workers) as pool:
+            futures = [pool.submit(self.run_one, spec, i) for i, spec in enumerate(specs)]
+            return [(spec, f.result()) for spec, f in zip(specs, futures)]
