@@ -56,6 +56,9 @@ def main(spec_path: str) -> None:
     model.train()
 
     bf16 = spec["dtype"] == "bf16"
+    fp16_cfg = spec.get("fp16")  # dynamic-loss-scale schedule parity leg
+    if fp16_cfg:
+        _ref_compat.enable_cpu_fp16()
     ds_config = {
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
@@ -69,6 +72,8 @@ def main(spec_path: str) -> None:
         "zero_optimization": {"stage": spec["zero_stage"]},
         "bf16": {"enabled": bf16},
     }
+    if fp16_cfg:
+        ds_config["fp16"] = dict(fp16_cfg, enabled=True)
     engine, _, _, _ = deepspeed.initialize(model=model, model_parameters=model.parameters(),
                                            config=ds_config, dist_init_required=True)
 
@@ -77,7 +82,7 @@ def main(spec_path: str) -> None:
     # (n_batches, global_batch, seq) stream cycled so the model memorizes
     rng = np.random.default_rng(spec["data_seed"])
     data = rng.integers(0, vocab, size=(spec["n_batches"], spec["global_batch"], spec["seq_len"]))
-    losses = []
+    losses, scales, overflows = [], [], []
     for step in range(spec["steps"]):
         batch = data[step % spec["n_batches"]]
         ids = torch.from_numpy(batch[rank * micro_bs:(rank + 1) * micro_bs].astype(np.int64))
@@ -88,9 +93,19 @@ def main(spec_path: str) -> None:
         engine.backward(loss)
         engine.step()
         losses.append(float(loss))
+        if fp16_cfg:
+            # zero fp16 optimizers carry a DynamicLossScaler; the unfused
+            # stage-0 wrapper inlines cur_scale directly
+            opt = engine.optimizer
+            scaler = getattr(opt, "loss_scaler", None)
+            scales.append(float(scaler.cur_scale if scaler is not None else opt.cur_scale))
+            overflows.append(bool(opt.overflow))
 
+    out = {"losses": losses}
+    if fp16_cfg:
+        out.update(scales=scales, overflows=overflows)
     with open(f"{spec['out_path']}.rank{rank}", "w") as f:
-        json.dump({"losses": losses}, f)
+        json.dump(out, f)
 
 
 if __name__ == "__main__":

@@ -29,3 +29,18 @@ def patch_deepspeed():
     # the SHM inference-allreduce op wants a JIT build (ninja python pkg
     # absent in this image); training collectives ride gloo, so skip it
     _dct.build_shm_op = lambda: None
+
+
+def enable_cpu_fp16():
+    """The reference CPU accelerator conservatively declares fp16
+    unsupported (``accelerator/cpu_accelerator.py:223``), but torch CPU
+    does fp16 math fine at parity-test scale; widening the two capability
+    probes lets the REAL FP16_UnfusedOptimizer + DynamicLossScaler path
+    run on gloo. Call after ``import deepspeed``."""
+    import torch
+
+    from deepspeed.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    acc.is_fp16_supported = lambda: True
+    acc.supported_dtypes = lambda: [torch.float, torch.bfloat16, torch.float16]

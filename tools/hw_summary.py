@@ -32,6 +32,17 @@ def main():
     else:
         print("no BENCH_extra.json yet")
 
+    sla_path = os.path.join(ROOT, "BENCH_SLA.json")
+    if os.path.exists(sla_path):
+        with open(sla_path) as f:
+            sla = json.load(f)
+        print(f"== serve SLA table (BENCH_SLA.json, platform={sla.get('platform')}) ==")
+        print("  rate(req/s)  tok/s    ttft p50/p95      tpot p50/p95     miss%")
+        for r in sla.get("rows", []):
+            print(f"  {r['arrival_rate']:>10}  {r['tokens_per_sec']:>7}  "
+                  f"{r['ttft_p50_s']:>7}/{r['ttft_p95_s']:<7}  "
+                  f"{r['tpot_p50_s']:>7}/{r['tpot_p95_s']:<7}  {100 * r['sla_miss_frac']:>5.1f}")
+
     sweep_path = os.path.join(ROOT, "TRAIN_SWEEP.jsonl")
     if os.path.exists(sweep_path):
         print("== train sweep (TRAIN_SWEEP.jsonl) ==")
@@ -53,7 +64,7 @@ def main():
         if best[0]:
             print(f"  -> best: {best[0]} at {best[1]} tok/s/chip")
 
-    for log in ("hw_session_r4.log", "hw_session.log"):
+    for log in ("hw_session_r5.log", "hw_session_r4.log", "hw_session.log"):
         p = os.path.join(ROOT, log)
         if os.path.exists(p):
             print(f"== session notes ({log}) ==")
