@@ -421,7 +421,8 @@ class InferenceEngineV2:
     # ---------------------------------------------------------- serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, do_sample: bool = False, temperature: float = 1.0,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0) -> List[List[int]]:
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 on_token=None) -> List[List[int]]:
         """Continuous-batching generation over a set of prompts — greedy by
         default, or sampled (``do_sample`` + temperature/top-k/top-p, the
         MII frontend's sampling surface). Sampling happens on device (the
@@ -431,15 +432,22 @@ class InferenceEngineV2:
         Drives the scheduler the way a serving frontend (MII) drives the
         reference engine: admit prefills as KV blocks free up, batch all
         live decodes each step.
+
+        ``on_token(uid, token)`` streams tokens as they are committed
+        (MII's streaming surface): one call per token, per-request order
+        preserved; a fused K-step burst delivers its K tokens back to
+        back when the burst completes — streaming granularity is the
+        price of burst throughput, and callers that need strict
+        per-token latency should configure ``decode_burst=0``.
         """
         self._sampling = (True, float(temperature), int(top_k), float(top_p)) if do_sample else None
         self._rng = jax.random.PRNGKey(seed)
         try:
-            return self._generate(prompts, max_new_tokens, eos_token_id)
+            return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
         finally:
             self._sampling = None
 
-    def _generate(self, prompts, max_new_tokens, eos_token_id) -> List[List[int]]:
+    def _generate(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
         reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
         pending = list(reqs.values())
         decode_ready: Dict[int, int] = {}  # uid -> next token to feed
@@ -450,6 +458,10 @@ class InferenceEngineV2:
             req = reqs[uid]
             if eos_token_id is not None and eos_token_id in toks_out:
                 toks_out = toks_out[:toks_out.index(eos_token_id) + 1]
+            if on_token is not None:
+                budget = req.max_new_tokens - len(results[uid])
+                for tok in toks_out[:budget]:
+                    on_token(uid, tok)
             results[uid].extend(toks_out)
             done = (len(results[uid]) >= req.max_new_tokens or
                     (eos_token_id is not None and toks_out[-1] == eos_token_id))

@@ -214,6 +214,34 @@ class TestDecodeBurst:
         assert out == full[:full.index(eos) + 1]
         assert eng.state.free_blocks == free0  # flushed despite early EOS
 
+    def test_streaming_callback(self, v2_setup):
+        """on_token streams every committed token in per-request order and
+        the concatenated stream equals the returned lists — with bursts on
+        (grouped delivery) and off (per-step delivery)."""
+        import dataclasses
+        model, params, cfg = v2_setup
+        prompts = [[3, 17, 42], [7, 7, 7, 7, 7]]
+        for burst in (0, 8):
+            eng = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=burst))
+            streamed = {0: [], 1: []}
+            out = eng.generate(prompts, max_new_tokens=6,
+                               on_token=lambda uid, tok: streamed[uid].append(tok))
+            assert [streamed[0], streamed[1]] == out, f"burst={burst}"
+
+    def test_streaming_respects_eos(self, v2_setup):
+        import dataclasses
+        model, params, cfg = v2_setup
+        prompt = [3, 17, 42, 9]
+        eng = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=8))
+        full = eng.generate([prompt], max_new_tokens=9)[0]
+        eos = full[4]
+        eng2 = InferenceEngineV2(model, params, dataclasses.replace(cfg, decode_burst=8))
+        streamed = []
+        out = eng2.generate([prompt], max_new_tokens=9, eos_token_id=eos,
+                            on_token=lambda uid, tok: streamed.append(tok))
+        assert streamed == out[0]          # nothing streamed past EOS
+        assert streamed[-1] == eos
+
     def test_burst_cache_lru_eviction(self, v2_setup, monkeypatch):
         """The bounded burst-program cache evicts least-recently-USED, not
         first-inserted: a hot signature (e.g. greedy) touched between other
