@@ -35,7 +35,14 @@ class DeepSpeedDataLoader:
                  seed: int = 0,
                  drop_last: bool = True,
                  topology: Optional[MeshTopology] = None,
-                 device_put: bool = True):
+                 device_put: bool = True,
+                 per_host: bool = False):
+        """``per_host=True`` builds each global batch lazily via
+        ``jax.make_array_from_callback``: a process only collates the rows
+        its own devices shard (the reference's ``DistributedSampler``
+        contract — each rank touches 1/dp of the data). Without it every
+        host materializes the full global batch and ``device_put`` slices
+        it, which is fine single-host but O(world) wasted IO on a pod."""
         self.dataset = dataset
         self.batch_size = batch_size
         self.collate_fn = collate_fn or default_collate
@@ -44,6 +51,7 @@ class DeepSpeedDataLoader:
         self.drop_last = drop_last
         self.topology = topology
         self.device_put = device_put
+        self.per_host = per_host and topology is not None
         self.epoch = 0
         n = len(dataset)
         self.len = n // batch_size if drop_last else math.ceil(n / batch_size)
@@ -69,12 +77,58 @@ class DeepSpeedDataLoader:
         shardings = specs_to_shardings(batch_specs(batch, self.topology), self.topology)
         return jax.device_put(batch, shardings)
 
+    def _put_per_host(self, sel: np.ndarray):
+        """Assemble the global batch without this host ever holding it:
+        per leaf, ``make_array_from_callback`` asks only for the row
+        ranges this process's devices own, and the callback collates
+        exactly those dataset rows (cached across leaves of one batch)."""
+        from .zero.partition import batch_specs, specs_to_shardings
+
+        B = len(sel)
+        # which rows does THIS process own? Dim-0 sharding over the batch
+        # axes is leaf-independent, so a shape-only dummy answers before
+        # any dataset access — the probe row must already be owned (a
+        # foreign probe would defeat the whole per-host contract)
+        row_sharding = jax.tree_util.tree_leaves(specs_to_shardings(
+            batch_specs({"x": np.zeros((1,), np.int32)}, self.topology), self.topology))[0]
+        owned = sorted({i for idx in row_sharding.addressable_devices_indices_map((B,)).values()
+                        for i in range(*idx[0].indices(B))})
+        probe = self.collate_fn([self.dataset[int(sel[owned[0]])]])
+        shardings = specs_to_shardings(batch_specs(probe, self.topology), self.topology)
+        cache = {}
+
+        def collated_row(r: int):
+            if r not in cache:
+                cache[r] = self.collate_fn([self.dataset[int(sel[r])]])
+            return cache[r]
+
+        probe_leaves, treedef = jax.tree_util.tree_flatten(probe)
+        shard_leaves = treedef.flatten_up_to(shardings)
+        leaf_ids = list(range(len(probe_leaves)))
+
+        def build(leaf_i, leaf_probe, sharding):
+            gshape = (B,) + tuple(leaf_probe.shape[1:])
+
+            def cb(index):
+                rows = range(*index[0].indices(B))
+                data = np.concatenate(
+                    [np.asarray(jax.tree_util.tree_leaves(collated_row(r))[leaf_i]) for r in rows])
+                return data[(slice(None),) + tuple(index[1:])]
+
+            return jax.make_array_from_callback(gshape, sharding, cb)
+
+        leaves = [build(i, p, s) for i, p, s in zip(leaf_ids, probe_leaves, shard_leaves)]
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
     def __iter__(self) -> Iterator:
         order = self._order()
         for b in range(self.len):
             sel = order[b * self.batch_size:(b + 1) * self.batch_size]
-            batch = self.collate_fn([self.dataset[int(i)] for i in sel])
-            yield self._put(batch)
+            if self.per_host:
+                yield self._put_per_host(sel)
+            else:
+                batch = self.collate_fn([self.dataset[int(i)] for i in sel])
+                yield self._put(batch)
         self.epoch += 1
 
 

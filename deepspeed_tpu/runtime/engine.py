@@ -485,13 +485,16 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------
     # data
     # ------------------------------------------------------------------
-    def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None, collate_fn=None, num_local_io_workers=None):
+    def deepspeed_io(self, dataset, batch_size=None, route=None, data_sampler=None, collate_fn=None,
+                     num_local_io_workers=None, per_host=False):
         """Reference ``engine.py:1692``: build the distributed loader. Batch
-        size here is the GLOBAL micro-batch (micro × dp degree) — one host
-        feeds the whole mesh."""
+        size here is the GLOBAL micro-batch (micro × dp degree). By default
+        one host feeds the whole mesh; ``per_host=True`` makes each process
+        collate only the rows its devices own (multi-host IO scaling — the
+        reference's DistributedSampler contract)."""
         global_micro = (batch_size or self.train_micro_batch_size_per_gpu) * self.topology.data_parallel_size
         return DeepSpeedDataLoader(dataset, batch_size=global_micro, collate_fn=collate_fn or self.collate_fn,
-                                   topology=self.topology)
+                                   topology=self.topology, per_host=per_host)
 
     def _put_batch(self, batch):
         if isinstance(batch, (dict, tuple, list)):
