@@ -679,16 +679,26 @@ class DeepSpeedEngine:
         prof.end_profile()
 
     def _next_lr(self) -> float:
+        lr = float(self._base_lr)
         if self.lr_scheduler is not None:
-            # the schedule clock ALWAYS advances (reference: scheduler.step()
-            # runs every step; a manual set_lr only masks one recomputation)
+            # reference ordering (engine.py: lr_scheduler.step() runs AFTER
+            # optimizer.step()): an optimizer step consumes the lr the
+            # PREVIOUS scheduler step installed. The first step therefore
+            # runs at the pre-schedule value — the optimizer's construction
+            # lr for the Warmup* family, or the schedule's documented start
+            # point (range-test min_lr / 1-cycle cycle_min_lr).
+            if getattr(self.lr_scheduler, "_last_lr", None) is not None:
+                lr = float(self.lr_scheduler.get_last_lr()[0])
+            else:
+                init = getattr(self.lr_scheduler, "initial_lr", lambda: None)()
+                if init is not None:
+                    lr = float(init)
+            # the schedule clock ALWAYS advances (a manual set_lr only
+            # masks one consumption)
             self.lr_scheduler.step()
         if self._lr_override is not None:
             lr, self._lr_override = self._lr_override, None
-            return lr
-        if self.lr_scheduler is not None:
-            return float(self.lr_scheduler.get_last_lr()[0])
-        return float(self._base_lr)
+        return lr
 
     def _report(self, lr):
         loss = float(self._last_loss) if self._last_loss is not None else float("nan")

