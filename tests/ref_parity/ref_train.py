@@ -68,8 +68,9 @@ def main(spec_path: str) -> None:
         # deepspeed_tpu's "Adam"+adam_w_mode=False produces
         "optimizer": {"type": "Adam",
                       "params": {"lr": spec["lr"], "betas": [0.9, 0.999], "eps": 1e-8,
-                                 "weight_decay": 0.0, "torch_adam": True,
-                                 "adam_w_mode": False}},
+                                 "weight_decay": float(spec.get("weight_decay", 0.0)),
+                                 "torch_adam": True,
+                                 "adam_w_mode": bool(spec.get("adam_w_mode", False))}},
         "zero_optimization": {"stage": spec["zero_stage"]},
         "bf16": {"enabled": bf16},
     }

@@ -108,7 +108,8 @@ def _run_reference(ckpt, tmp_path, dtype, zero_stage, world, extra_spec=None,
     return np.mean(np.asarray([p["losses"] for p in per_rank]), axis=0)
 
 
-def _run_native(ckpt, dtype, zero_stage, gas=1, clip=0.0, scheduler=None):
+def _run_native(ckpt, dtype, zero_stage, gas=1, clip=0.0, scheduler=None,
+                weight_decay=0.0, adam_w_mode=False):
     """Train the converted checkpoint through deepspeed_tpu on the default
     (8-virtual-device data-parallel) mesh; returns the per-step global mean
     loss. The dp degree is immaterial to the math — the loss/grad are means
@@ -127,7 +128,7 @@ def _run_native(ckpt, dtype, zero_stage, gas=1, clip=0.0, scheduler=None):
         "gradient_accumulation_steps": gas,
         "optimizer": {"type": "adam",
                       "params": {"lr": LR, "betas": [0.9, 0.999], "eps": 1e-8,
-                                 "weight_decay": 0.0, "adam_w_mode": False}},
+                                 "weight_decay": weight_decay, "adam_w_mode": adam_w_mode}},
         "zero_optimization": {"stage": zero_stage},
         "bf16": {"enabled": dtype == "bf16"},
         "steps_per_print": 1 << 30,
@@ -316,7 +317,10 @@ def test_loss_curve_matches_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, wo
      "native": {"scheduler": {"type": "WarmupLR",
                               "params": {"warmup_min_lr": 0.0, "warmup_max_lr": LR,
                                          "warmup_num_steps": 50}}}},
-], ids=["gas2", "grad-clip", "warmup-lr"])
+    # decoupled AdamW: torch AdamW's lr-scaled decay vs optax.adamw's
+    {"spec": {"weight_decay": 0.1, "adam_w_mode": True},
+     "native": {"weight_decay": 0.1, "adam_w_mode": True}},
+], ids=["gas2", "grad-clip", "warmup-lr", "adamw-decay"])
 def test_training_feature_matches_reference(gpt2_ckpt, tmp_path, leg):
     """Composition legs: each exercises one more piece of the training
     contract end-to-end against the reference engine (fp32, zero-1)."""
