@@ -174,8 +174,11 @@ class OneCycle(_BaseSchedule):
         self.cycle_min_lr = cycle_min_lr
         self.cycle_max_lr = cycle_max_lr
         self.decay_lr_rate = decay_lr_rate
-        self.first_size = cycle_first_step_size
-        self.second_size = cycle_second_step_size if cycle_second_step_size is not None else cycle_first_step_size
+        self.first_size = float(cycle_first_step_size)
+        self.second_size = float(cycle_second_step_size) if cycle_second_step_size is not None \
+            else self.first_size
+        self.total_size = self.first_size + self.second_size
+        self.step_ratio = self.first_size / self.total_size
         self.decay_step_size = decay_step_size
         self.last_batch_iteration = last_batch_iteration
 
@@ -183,20 +186,20 @@ class OneCycle(_BaseSchedule):
         return self.cycle_min_lr  # reference _initialize_lr (:494)
 
     def get_lr(self) -> List[float]:
-        step = self.last_batch_iteration + 1
-        total_cycle = self.first_size + self.second_size
-        if step <= self.first_size:
-            frac = step / self.first_size
-            return [self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * frac]
-        if step <= total_cycle:
-            frac = (step - self.first_size) / self.second_size
-            return [self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * frac]
-        decay_steps = step - total_cycle
-        if self.decay_step_size > 0:
-            decay = self.decay_lr_rate * (decay_steps // self.decay_step_size)
-        else:
-            decay = self.decay_lr_rate * decay_steps
-        return [max(0.0, self.cycle_min_lr * (1 - decay)) if decay < 1 else 0.0]
+        # reference OneCycle semantics exactly (lr_schedules.py:528,583):
+        # triangular scale over (lbi+1) while lbi < total_size, then
+        # post-cycle decay of min_lr by 1/(1 + rate * t/decay_step_size)
+        if self.last_batch_iteration < self.total_size:
+            bi = self.last_batch_iteration + 1
+            cycle = math.floor(1 + bi / self.total_size)
+            x = 1.0 + bi / self.total_size - cycle
+            scale = x / self.step_ratio if x <= self.step_ratio \
+                else (x - 1) / (self.step_ratio - 1)
+            return [self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * scale]
+        if self.decay_step_size == 0 or self.decay_lr_rate == 0:
+            return [self.cycle_min_lr]
+        decay_bi = self.last_batch_iteration - self.total_size + 1
+        return [self.cycle_min_lr / (1 + self.decay_lr_rate * (decay_bi / self.decay_step_size))]
 
 
 def get_lr_schedule_class(name: str):
