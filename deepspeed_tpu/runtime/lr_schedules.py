@@ -152,7 +152,10 @@ class LRRangeTest(_BaseSchedule):
         self.last_batch_iteration = last_batch_iteration
 
     def initial_lr(self) -> Optional[float]:
-        return self.min_lr  # reference pre-installs it at construction (:330)
+        # reference pre-installs min_lr ONLY for a fresh schedule (:330
+        # `if last_batch_iteration == -1`); a config-resumed clock keeps
+        # the optimizer's construction lr for its first consumption
+        return self.min_lr if self.last_batch_iteration == -1 else None
 
     def get_lr(self) -> List[float]:
         count = (self.last_batch_iteration + 1) / self.step_size
@@ -183,7 +186,8 @@ class OneCycle(_BaseSchedule):
         self.last_batch_iteration = last_batch_iteration
 
     def initial_lr(self) -> Optional[float]:
-        return self.cycle_min_lr  # reference _initialize_lr (:494)
+        # reference _initialize_lr (:494) — same fresh-clock-only gate
+        return self.cycle_min_lr if self.last_batch_iteration == -1 else None
 
     def get_lr(self) -> List[float]:
         # reference OneCycle semantics exactly (lr_schedules.py:528,583):

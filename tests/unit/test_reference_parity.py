@@ -337,10 +337,12 @@ def test_loss_curve_matches_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, wo
     {"spec": {"scheduler": {"type": "OneCycle",
                             "params": {"cycle_min_lr": 1e-4, "cycle_max_lr": 1e-3,
                                        "cycle_first_step_size": 40,
+                                       "decay_lr_rate": 0.5, "decay_step_size": 20,
                                        "cycle_momentum": False}}},
      "native": {"scheduler": {"type": "OneCycle",
                               "params": {"cycle_min_lr": 1e-4, "cycle_max_lr": 1e-3,
                                          "cycle_first_step_size": 40,
+                                         "decay_lr_rate": 0.5, "decay_step_size": 20,
                                          "cycle_momentum": False}}}},
 ], ids=["gas2", "grad-clip", "warmup-lr", "adamw-decay", "lr-range-test", "one-cycle"])
 def test_training_feature_matches_reference(gpt2_ckpt, tmp_path, leg):
