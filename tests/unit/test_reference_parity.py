@@ -344,7 +344,14 @@ def test_loss_curve_matches_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, wo
                                          "cycle_first_step_size": 40,
                                          "decay_lr_rate": 0.5, "decay_step_size": 20,
                                          "cycle_momentum": False}}}},
-], ids=["gas2", "grad-clip", "warmup-lr", "adamw-decay", "lr-range-test", "one-cycle"])
+    {"spec": {"scheduler": {"type": "WarmupCosineLR",
+                            "params": {"total_num_steps": 200, "warmup_num_steps": 20,
+                                       "cos_min_ratio": 0.1}}},
+     "native": {"scheduler": {"type": "WarmupCosineLR",
+                              "params": {"total_num_steps": 200, "warmup_num_steps": 20,
+                                         "cos_min_ratio": 0.1}}}},
+], ids=["gas2", "grad-clip", "warmup-lr", "adamw-decay", "lr-range-test", "one-cycle",
+        "warmup-cosine"])
 def test_training_feature_matches_reference(gpt2_ckpt, tmp_path, leg):
     """Composition legs: each exercises one more piece of the training
     contract end-to-end against the reference engine (fp32, zero-1)."""
