@@ -92,8 +92,10 @@ def _run_reference(ckpt, tmp_path, dtype, zero_stage, world, extra_spec=None,
         env = dict(os.environ)
         env.update({"RANK": str(r), "WORLD_SIZE": str(world), "LOCAL_RANK": str(r),
                     "MASTER_ADDR": "127.0.0.1", "MASTER_PORT": str(port),
-                    # keep the reference torch run off the TPU tunnel and quiet
-                    "DS_ACCELERATOR": "cpu", "CUDA_VISIBLE_DEVICES": ""})
+                    # keep the reference torch run off the TPU tunnel and quiet;
+                    # LOCAL_SIZE short-circuits the CPU accelerator's numactl
+                    # probe (binary absent here) that zero-3 grad scatter hits
+                    "DS_ACCELERATOR": "cpu", "CUDA_VISIBLE_DEVICES": "", "LOCAL_SIZE": "1"})
         procs.append(subprocess.Popen([sys.executable, REF_TRAIN, str(spec_path)],
                                       stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env))
     outs = [p.communicate(timeout=900)[0].decode(errors="replace") for p in procs]
@@ -290,11 +292,12 @@ def test_fp16_loss_scale_schedule_matches_reference(gpt2_ckpt, tmp_path):
     ("fp32", 0, 1, 5e-5, 5e-4),
     ("fp32", 0, 2, 5e-5, 5e-4),
     ("fp32", 2, 2, 5e-5, 5e-4),
+    ("fp32", 3, 2, 5e-5, 5e-4),
     # bf16 matmul rounding differs between oneDNN and XLA CPU emulation;
     # the band is correspondingly wider but still curve-shaped-tight
     ("bf16", 1, 1, 5e-3, 1e-1),
     ("bf16", 1, 2, 5e-3, 1e-1),
-], ids=["fp32-z0-w1", "fp32-z0-w2", "fp32-z2-w2", "bf16-z1-w1", "bf16-z1-w2"])
+], ids=["fp32-z0-w1", "fp32-z0-w2", "fp32-z2-w2", "fp32-z3-w2", "bf16-z1-w1", "bf16-z1-w2"])
 def test_loss_curve_matches_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, world,
                                       early_tol, late_tol):
     ref = _run_reference(gpt2_ckpt, tmp_path, dtype, zero_stage, world)
