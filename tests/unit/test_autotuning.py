@@ -113,82 +113,6 @@ def test_failed_experiments_pruned():
     with pytest.raises(RuntimeError):
         at2.tune(stages=[0], micro_batches=[1])
 
-class TestTrialIsolation:
-    """Subprocess trials (reference scheduler.py contract): a crashing or
-    OOM-killed experiment scores None and the search continues — the
-    exact failure class the in-process path cannot survive."""
-
-    def _iso_autotuner(self, extra_at=None, **kw):
-        import dataclasses
-        import os
-
-        from deepspeed_tpu.models import gpt2_tiny
-
-        # subprocess trials share the suite's persistent compile cache
-        os.environ.setdefault("DS_AT_COMPILE_CACHE",
-                              os.path.join(os.path.dirname(__file__), ".jax_cache"))
-        factory, batches = _tiny_setup()
-        cfg_small = dataclasses.replace(gpt2_tiny(), vocab_size=1024)
-        at_cfg = {"trial_isolation": True, "trial_timeout_s": 300, **(extra_at or {})}
-        base = {"train_micro_batch_size_per_gpu": 1, "optimizer": {"type": "adam"},
-                "autotuning": at_cfg}
-        return Autotuner(factory, base, batches, model_spec=cfg_small,
-                         steps_per_trial=1, warmup_steps=1, **kw)
-
-    def test_survives_hard_crashing_trial(self, monkeypatch):
-        """DS_AT_TEST_CRASH_STAGE makes the stage-0 trial os.abort() —
-        the SIGABRT analogue of an OOM kill. The tuner must survive it,
-        score that trial None, and still pick the surviving config."""
-        monkeypatch.setenv("DS_AT_TEST_CRASH_STAGE", "0")
-        at = self._iso_autotuner()
-        best = at.tune(stages=[0, 1], micro_batches=[1])
-        assert best["zero_optimization"]["stage"] == 1
-        by_stage = {r["exp"]["zero_optimization"]["stage"]: r["throughput"] for r in at.records}
-        assert by_stage[0] is None and by_stage[1] > 0
-
-    def test_parallel_trials_complete(self):
-        at = self._iso_autotuner(extra_at={"parallel_trials": 2})
-        best = at.tune(stages=[0, 1], micro_batches=[1])
-        assert best["zero_optimization"]["stage"] in (0, 1)
-        assert len(at.records) == 2
-        assert all(r["throughput"] is not None for r in at.records)
-
-    def test_isolation_requires_model_spec(self):
-        factory, batches = _tiny_setup()
-        at = Autotuner(factory, {"train_micro_batch_size_per_gpu": 1,
-                                 "autotuning": {"trial_isolation": True}}, batches)
-        with pytest.raises(ValueError, match="model_spec"):
-            at.tune(stages=[0], micro_batches=[1])
-
-
-def test_trial_runner_spec_roundtrip(tmp_path):
-    """The runner's spec surface directly: build-from-kwargs + npz batches."""
-    import json
-    import subprocess
-    import sys
-
-    import os
-
-    os.environ.setdefault("DS_AT_COMPILE_CACHE",
-                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
-    rng = np.random.RandomState(0)
-    npz = tmp_path / "b.npz"
-    np.savez(npz, input_ids=rng.randint(0, 256, size=(2, 8, 16)).astype(np.int32))
-    spec = {"config": {"train_micro_batch_size_per_gpu": 1,
-                       "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
-                       "zero_optimization": {"stage": 1}},
-            "model": {"vocab_size": 256, "n_layers": 1, "n_heads": 2, "d_model": 16,
-                      "max_seq_len": 32},
-            "batches_npz": str(npz), "steps_per_trial": 1, "warmup_steps": 1}
-    sp, out = tmp_path / "spec.json", tmp_path / "out.json"
-    sp.write_text(json.dumps(spec))
-    proc = subprocess.run([sys.executable, "-m", "deepspeed_tpu.autotuning.trial_runner",
-                           str(sp), str(out)], capture_output=True, timeout=300)
-    assert proc.returncode == 0, proc.stderr.decode(errors="replace")[-2000:]
-    res = json.loads(out.read_text())
-    assert res["value"] > 0
-
-
 def test_scheduler_failure_paths(tmp_path):
     """Bad spec -> None (not an exception); timeout -> None."""
     from deepspeed_tpu.autotuning import TrialScheduler
@@ -208,5 +132,6 @@ def test_hostfile_prefixes(tmp_path):
     assert all(p[0] == "ssh" for p in prefixes)
 
 
-# quick tier: `pytest -m fast` smoke run
+# quick tier: `pytest -m fast` smoke run (subprocess-spawning isolation
+# cases live in test_autotuning_isolation.py, default tier only)
 pytestmark = pytest.mark.fast
