@@ -72,13 +72,24 @@ def measure_memory(engine, batch) -> Optional[int]:
     allocator stats (true runtime peak, zero extra compilation);
     falls back to XLA buffer-assignment totals of the train step
     (pays one re-lower, but lower()/compile() hit the jit cache's
-    already-built executable on most backends)."""
+    already-built executable on most backends).
+
+    The allocator peak is PROCESS-LIFETIME: in a sequential in-process
+    search a small trial after a big one would inherit the big trial's
+    peak and be wrongly budget-rejected. The peak is only trusted when
+    it ADVANCED past the previous measurement (this trial set it);
+    otherwise fall through to the per-compile estimate. Subprocess-
+    isolated trials (trial_runner) never hit this — fresh process each."""
     import jax
 
     try:
         stats = jax.local_devices()[0].memory_stats()
-        if stats and stats.get("peak_bytes_in_use"):
-            return int(stats["peak_bytes_in_use"])
+        peak = int(stats.get("peak_bytes_in_use", 0)) if stats else 0
+        if peak:
+            prev = getattr(measure_memory, "_last_peak", 0)
+            measure_memory._last_peak = max(prev, peak)
+            if peak > prev:
+                return peak
     except Exception:
         pass
     try:

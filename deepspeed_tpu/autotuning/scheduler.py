@@ -27,14 +27,17 @@ from ..utils.logging import logger
 
 
 def ssh_prefixes_from_hostfile(hostfile_path: str) -> List[List[str]]:
-    """One ``ssh host`` prefix per hostfile entry (reference hostfile
-    format, parsed by the launcher's own reader)."""
+    """One ``ssh host`` prefix per hostfile SLOT (a host with slots=4
+    yields 4 prefixes), so worker slots map to real capacity instead of
+    piling n_workers onto one host (reference hostfile format, parsed by
+    the launcher's own reader)."""
     from ..launcher.runner import fetch_hostfile
 
     hosts = fetch_hostfile(hostfile_path)
     if not hosts:
         raise ValueError(f"no hosts parsed from {hostfile_path}")
-    return [["ssh", "-o", "StrictHostKeyChecking=no", h] for h in hosts]
+    return [["ssh", "-o", "StrictHostKeyChecking=no", h]
+            for h, slots in hosts.items() for _ in range(max(1, int(slots)))]
 
 
 class TrialScheduler:
@@ -48,18 +51,28 @@ class TrialScheduler:
         self.env = env
 
     def run_one(self, spec: Dict, slot: int = 0) -> Optional[Dict]:
-        """Write the spec, launch the runner (with the slot's host
-        prefix), parse the result: {"value": float, "memory_bytes":
-        int|None}, or None on any failure/timeout/kill."""
+        """Launch the runner on the slot and parse its result:
+        {"value": float, "memory_bytes": int|None}, or None on any
+        failure/timeout/kill.
+
+        Local slots (empty prefix) use temp-file transport. Prefixed
+        slots (ssh) use PIPE transport — the spec (with batches inlined
+        base64) goes over stdin, the result comes back as a
+        DS_TRIAL_RESULT stdout line — because the local temp dir does
+        not exist on the executing host. A timeout kills only the local
+        client; a remote trial may linger until it finishes (documented
+        limit of ssh transport without a remote agent)."""
+        prefix = self.prefixes[slot % len(self.prefixes)]
+        env = dict(os.environ, **(self.env or {}))
+        if prefix:
+            return self._run_piped(spec, prefix, env)
         with tempfile.TemporaryDirectory(prefix="ds_at_trial_") as d:
             spec_path = os.path.join(d, "spec.json")
             out_path = os.path.join(d, "out.json")
             with open(spec_path, "w") as f:
                 json.dump(spec, f)
-            prefix = self.prefixes[slot % len(self.prefixes)]
-            cmd = prefix + [sys.executable, "-m", "deepspeed_tpu.autotuning.trial_runner",
-                            spec_path, out_path]
-            env = dict(os.environ, **(self.env or {}))
+            cmd = [sys.executable, "-m", "deepspeed_tpu.autotuning.trial_runner",
+                   spec_path, out_path]
             try:
                 proc = subprocess.run(cmd, capture_output=True, timeout=self.timeout_s, env=env)
             except subprocess.TimeoutExpired:
@@ -72,6 +85,30 @@ class TrialScheduler:
                 return None
             with open(out_path) as f:
                 return json.load(f)
+
+    def _run_piped(self, spec: Dict, prefix: List[str], env: Dict[str, str]) -> Optional[Dict]:
+        import base64
+
+        from .trial_runner import RESULT_SENTINEL
+
+        spec = dict(spec)
+        npz = spec.pop("batches_npz", None)
+        if npz and "batches_b64" not in spec:
+            with open(npz, "rb") as f:
+                spec["batches_b64"] = base64.b64encode(f.read()).decode()
+        cmd = prefix + ["python", "-m", "deepspeed_tpu.autotuning.trial_runner", "-"]
+        try:
+            proc = subprocess.run(cmd, input=json.dumps(spec).encode(), capture_output=True,
+                                  timeout=self.timeout_s, env=env)
+        except subprocess.TimeoutExpired:
+            logger.warning(f"autotuning trial timed out after {self.timeout_s:.0f}s: {cmd}")
+            return None
+        for line in reversed(proc.stdout.decode(errors="replace").splitlines()):
+            if line.startswith(RESULT_SENTINEL):
+                return json.loads(line[len(RESULT_SENTINEL):])
+        tail = proc.stderr.decode(errors="replace")[-2000:]
+        logger.warning(f"autotuning remote trial failed rc={proc.returncode}:\n{tail}")
+        return None
 
     def run_many(self, specs: Sequence[Dict]) -> List[Tuple[Dict, Optional[Dict]]]:
         """All specs over the worker pool; returns (spec, value) pairs in

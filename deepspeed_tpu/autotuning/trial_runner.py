@@ -43,8 +43,18 @@ def build_model(model_ref):
     return CausalLM(TransformerConfig(**model_ref))
 
 
-def load_batches(npz_path):
-    with np.load(npz_path) as z:
+def load_batches(spec):
+    """Batches from ``batches_npz`` (local path) or ``batches_b64``
+    (npz bytes inline in the spec — the remote/ssh transport, where the
+    scheduler's temp files do not exist on the executing host)."""
+    if "batches_b64" in spec:
+        import base64
+        import io
+
+        z = np.load(io.BytesIO(base64.b64decode(spec["batches_b64"])))
+    else:
+        z = np.load(spec["batches_npz"])
+    with z:
         stacks = {k: z[k] for k in z.files}
     n = next(iter(stacks.values())).shape[0]
     return [{k: v[i] for k, v in stacks.items()} for i in range(n)]
@@ -70,7 +80,7 @@ def run_spec(spec: dict) -> dict:
     from .autotuner import run_trial
 
     model = build_model(spec["model"])
-    batches = load_batches(spec["batches_npz"])
+    batches = load_batches(spec)
     params = model.init(jax.random.PRNGKey(0), batches[0])
     val, mem = run_trial(model, params, spec["config"], batches,
                          int(spec.get("steps_per_trial", 4)), int(spec.get("warmup_steps", 1)),
@@ -78,14 +88,24 @@ def run_spec(spec: dict) -> dict:
     return {"value": float(val), "memory_bytes": mem}
 
 
+RESULT_SENTINEL = "DS_TRIAL_RESULT "
+
+
 def main(argv=None) -> int:
+    """File transport: ``trial_runner spec.json out.json``. Pipe transport
+    (remote slots — no shared filesystem): ``trial_runner -`` reads the
+    spec from stdin and prints ``DS_TRIAL_RESULT {json}`` on stdout."""
     argv = argv if argv is not None else sys.argv[1:]
-    if len(argv) != 2:
-        print("usage: python -m deepspeed_tpu.autotuning.trial_runner <spec.json> <out.json>",
+    pipe = argv == ["-"]
+    if not pipe and len(argv) != 2:
+        print("usage: python -m deepspeed_tpu.autotuning.trial_runner <spec.json> <out.json> | -",
               file=sys.stderr)
         return 2
-    with open(argv[0]) as f:
-        spec = json.load(f)
+    if pipe:
+        spec = json.load(sys.stdin)
+    else:
+        with open(argv[0]) as f:
+            spec = json.load(f)
     crash_stage = os.environ.get("DS_AT_TEST_CRASH_STAGE")
     if crash_stage is not None and \
             spec["config"].get("zero_optimization", {}).get("stage") == int(crash_stage):
@@ -93,6 +113,9 @@ def main(argv=None) -> int:
         # hard kill (OOM killer / XLA abort) that no try/except survives
         os.abort()
     out = run_spec(spec)
+    if pipe:
+        print(RESULT_SENTINEL + json.dumps(out), flush=True)
+        return 0
     tmp = argv[1] + ".tmp"
     with open(tmp, "w") as f:
         json.dump(out, f)

@@ -93,6 +93,11 @@ class DeepSpeedDataLoader:
             batch_specs({"x": np.zeros((1,), np.int32)}, self.topology), self.topology))[0]
         owned = sorted({i for idx in row_sharding.addressable_devices_indices_map((B,)).values()
                         for i in range(*idx[0].indices(B))})
+        if not owned:
+            raise ValueError(
+                f"per_host loader: this process owns no rows of a {B}-row batch "
+                "(short final batch under drop_last=False, or batch < dp degree) — "
+                "use drop_last=True or the eager loader for this dataset")
         probe = self.collate_fn([self.dataset[int(sel[owned[0]])]])
         shardings = specs_to_shardings(batch_specs(probe, self.topology), self.topology)
         cache = {}
@@ -111,8 +116,17 @@ class DeepSpeedDataLoader:
 
             def cb(index):
                 rows = range(*index[0].indices(B))
-                data = np.concatenate(
-                    [np.asarray(jax.tree_util.tree_leaves(collated_row(r))[leaf_i]) for r in rows])
+                parts = [np.asarray(jax.tree_util.tree_leaves(collated_row(r))[leaf_i]) for r in rows]
+                if any(p.shape[1:] != gshape[1:] for p in parts):
+                    # a pad-to-batch-max collate gives rows different widths
+                    # when collated one at a time — a contract the lazy path
+                    # cannot honor (and that would desync shard widths on a
+                    # pod); fail with the reason, not a concatenate error
+                    raise ValueError(
+                        "per_host loader needs row-shape-stable collate output "
+                        f"(probe {gshape[1:]}, got {[p.shape[1:] for p in parts]}); "
+                        "pad per-row (e.g. to a fixed max_seq_len) or use the eager loader")
+                data = np.concatenate(parts)
                 return data[(slice(None),) + tuple(index[1:])]
 
             return jax.make_array_from_callback(gshape, sharding, cb)

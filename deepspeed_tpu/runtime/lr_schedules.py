@@ -48,7 +48,11 @@ class _BaseSchedule:
 
     def load_state_dict(self, sd: Dict):
         self.last_batch_iteration = sd["last_batch_iteration"]
-        self._last_lr = self.get_lr()
+        if self.last_batch_iteration >= 0:
+            self._last_lr = self.get_lr()
+        # lbi < 0: the schedule never started — leave _last_lr unset so the
+        # engine's first consumption stays at the pre-schedule lr, exactly
+        # like a fresh scheduler
 
 
 class WarmupLR(_BaseSchedule):
@@ -71,6 +75,11 @@ class WarmupLR(_BaseSchedule):
         # keyed on last_batch_iteration exactly as the reference's
         # _get_gamma (lr_schedules.py:705): the engine consumes the value a
         # step() call computed, so the clock must not be pre-advanced here
+        if self.last_batch_iteration < 0:
+            # fresh clock: the reference's get_lr guard (:679) — never
+            # log(0) / negative-lr here (hit via load_state_dict of a
+            # checkpoint taken before the first optimizer step)
+            return 0.0
         if self.last_batch_iteration < self.warmup_num_steps:
             if self.warmup_type == "log":
                 return self.inverse_log_warm_up * math.log(self.last_batch_iteration + 1)

@@ -88,3 +88,57 @@ def test_trial_runner_spec_roundtrip(tmp_path):
     assert res["value"] > 0
 
 
+
+
+def test_scheduler_failure_paths(tmp_path):
+    """Bad spec -> None (not an exception); timeout -> None."""
+    from deepspeed_tpu.autotuning import TrialScheduler
+
+    sched = TrialScheduler(n_workers=1, timeout_s=60)
+    assert sched.run_one({"config": {}, "model": {"no_such_field": 1},
+                          "batches_npz": "/nonexistent.npz"}) is None
+
+
+def test_pipe_transport_roundtrip(tmp_path):
+    """Prefixed (remote) slots pipe the spec over stdin — batches inlined
+    base64 — and read the DS_TRIAL_RESULT stdout line: the transport that
+    works when the scheduler's temp dir does not exist on the executing
+    host. `env` as a no-op prefix exercises it locally."""
+    import json
+    import os
+
+    from deepspeed_tpu.autotuning import TrialScheduler
+
+    os.environ.setdefault("DS_AT_COMPILE_CACHE",
+                          os.path.join(os.path.dirname(__file__), ".jax_cache"))
+    rng = np.random.RandomState(0)
+    npz = tmp_path / "b.npz"
+    np.savez(npz, input_ids=rng.randint(0, 256, size=(2, 8, 16)).astype(np.int32))
+    spec = {"config": {"train_micro_batch_size_per_gpu": 1,
+                       "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                       "zero_optimization": {"stage": 1}},
+            "model": {"vocab_size": 256, "n_layers": 1, "n_heads": 2, "d_model": 16,
+                      "max_seq_len": 32},
+            "batches_npz": str(npz), "steps_per_trial": 1, "warmup_steps": 1}
+    sched = TrialScheduler(n_workers=1, launch_prefixes=[["env"]], timeout_s=300)
+    out = sched.run_one(spec)
+    assert out is not None and out["value"] > 0
+
+
+def test_trial_timeout_returns_none(tmp_path):
+    """A hung trial (batches npz is a never-written FIFO) trips the
+    scheduler timeout and scores None instead of wedging the search."""
+    import os
+
+    from deepspeed_tpu.autotuning import TrialScheduler
+
+    fifo = tmp_path / "hang.npz"
+    os.mkfifo(fifo)
+    spec = {"config": {"train_micro_batch_size_per_gpu": 1,
+                       "optimizer": {"type": "adam", "params": {"lr": 1e-3}},
+                       "zero_optimization": {"stage": 0}},
+            "model": {"vocab_size": 64, "n_layers": 1, "n_heads": 2, "d_model": 16,
+                      "max_seq_len": 32},
+            "batches_npz": str(fifo), "steps_per_trial": 1, "warmup_steps": 0}
+    sched = TrialScheduler(n_workers=1, timeout_s=20)
+    assert sched.run_one(spec) is None

@@ -180,6 +180,26 @@ def test_lr_scheduler_warmup():
     assert 0 < lr < 0.01
 
 
+def test_scheduler_resume_before_first_step(tmp_path):
+    """A checkpoint saved BEFORE the first optimizer step stores a fresh
+    scheduler clock (last_batch_iteration=-1); loading it must neither
+    crash (log warmup: math.log(0)) nor install a negative lr — the
+    resumed engine's first step runs at the pre-schedule lr, like a
+    fresh scheduler (reference get_lr guard, lr_schedules.py:679)."""
+    sched = {"scheduler": {"type": "WarmupLR",
+                           "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                                      "warmup_num_steps": 10}}}
+    a = _make_engine(stage=0, extra=sched)
+    a.save_checkpoint(str(tmp_path / "ckpt"), tag="fresh")
+    b = _make_engine(stage=0, extra=sched)
+    b.load_checkpoint(str(tmp_path / "ckpt"), tag="fresh")
+    assert b.lr_scheduler.last_batch_iteration == -1
+    losses = _train(b, steps=2)
+    assert all(np.isfinite(l) for l in losses)
+    # after 2 steps the log-warmup clock sits at lbi=1: lr = log(2)/log(10) * max
+    assert b.get_lr()[0] == pytest.approx(0.01 * np.log(2) / np.log(10), rel=1e-6)
+
+
 def test_fp16_dynamic_loss_scale_runs():
     engine = _make_engine(stage=0, extra={"fp16": {"enabled": True, "initial_scale_power": 8}})
     losses = _train(engine, steps=2)
