@@ -21,6 +21,7 @@ import subprocess
 import sys
 import tempfile
 from concurrent.futures import ThreadPoolExecutor
+from queue import Queue
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from ..utils.logging import logger
@@ -44,11 +45,14 @@ class TrialScheduler:
     """Run trial specs concurrently in isolated subprocesses."""
 
     def __init__(self, n_workers: int = 2, launch_prefixes: Optional[Sequence[Sequence[str]]] = None,
-                 timeout_s: float = 600.0, env: Optional[Dict[str, str]] = None):
+                 timeout_s: float = 600.0, env: Optional[Dict[str, str]] = None,
+                 remote_python: str = "python3"):
         self.n_workers = max(1, int(n_workers))
         self.prefixes = [list(p) for p in launch_prefixes] if launch_prefixes else [[]]
         self.timeout_s = float(timeout_s)
         self.env = env
+        self.remote_python = remote_python  # bare "python" is absent on python3-only hosts
+        self._b64_cache: Dict[str, str] = {}
 
     def run_one(self, spec: Dict, slot: int = 0) -> Optional[Dict]:
         """Launch the runner on the slot and parse its result:
@@ -62,6 +66,13 @@ class TrialScheduler:
         not exist on the executing host. A timeout kills only the local
         client; a remote trial may linger until it finishes (documented
         limit of ssh transport without a remote agent)."""
+        try:
+            return self._run_one(spec, slot)
+        except Exception as e:  # noqa: BLE001 — the contract is None on ANY failure
+            logger.warning(f"autotuning trial errored ({type(e).__name__}: {e}); scoring None")
+            return None
+
+    def _run_one(self, spec: Dict, slot: int) -> Optional[Dict]:
         prefix = self.prefixes[slot % len(self.prefixes)]
         env = dict(os.environ, **(self.env or {}))
         if prefix:
@@ -94,9 +105,11 @@ class TrialScheduler:
         spec = dict(spec)
         npz = spec.pop("batches_npz", None)
         if npz and "batches_b64" not in spec:
-            with open(npz, "rb") as f:
-                spec["batches_b64"] = base64.b64encode(f.read()).decode()
-        cmd = prefix + ["python", "-m", "deepspeed_tpu.autotuning.trial_runner", "-"]
+            if npz not in self._b64_cache:  # every spec shares one npz; encode once
+                with open(npz, "rb") as f:
+                    self._b64_cache[npz] = base64.b64encode(f.read()).decode()
+            spec["batches_b64"] = self._b64_cache[npz]
+        cmd = prefix + [self.remote_python, "-m", "deepspeed_tpu.autotuning.trial_runner", "-"]
         try:
             proc = subprocess.run(cmd, input=json.dumps(spec).encode(), capture_output=True,
                                   timeout=self.timeout_s, env=env)
@@ -112,7 +125,23 @@ class TrialScheduler:
 
     def run_many(self, specs: Sequence[Dict]) -> List[Tuple[Dict, Optional[Dict]]]:
         """All specs over the worker pool; returns (spec, value) pairs in
-        submission order (results internally complete out of order)."""
+        submission order (results internally complete out of order).
+
+        Slots are leased from a free-slot pool rather than derived from
+        the spec index: with per-host prefixes, a trial must land on a
+        host whose slot is actually free, not on ``i % len(prefixes)``
+        (which can double-book one host while another idles)."""
+        free_slots: "Queue[int]" = Queue()
+        for s in range(self.n_workers):
+            free_slots.put(s)
+
+        def leased(spec: Dict) -> Optional[Dict]:
+            slot = free_slots.get()
+            try:
+                return self.run_one(spec, slot)
+            finally:
+                free_slots.put(slot)
+
         with ThreadPoolExecutor(max_workers=self.n_workers) as pool:
-            futures = [pool.submit(self.run_one, spec, i) for i, spec in enumerate(specs)]
+            futures = [pool.submit(leased, spec) for spec in specs]
             return [(spec, f.result()) for spec, f in zip(specs, futures)]

@@ -50,9 +50,12 @@ class _BaseSchedule:
         self.last_batch_iteration = sd["last_batch_iteration"]
         if self.last_batch_iteration >= 0:
             self._last_lr = self.get_lr()
-        # lbi < 0: the schedule never started — leave _last_lr unset so the
-        # engine's first consumption stays at the pre-schedule lr, exactly
-        # like a fresh scheduler
+        else:
+            # lbi < 0: the schedule never started — remove _last_lr (the
+            # scheduler may have stepped before this load) so the engine's
+            # first consumption stays at the pre-schedule lr, exactly like
+            # a fresh scheduler (engine.get_lr() keys off hasattr)
+            self.__dict__.pop("_last_lr", None)
 
 
 class WarmupLR(_BaseSchedule):

@@ -45,9 +45,17 @@ def _ops():
                                   .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
             gx = jax.jit(jax.grad(lambda q, k, v: attention_xla(q, k, v, causal=True, **kw)
                                   .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
-            for a, b in zip(gf, gx):
-                d = float(jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))))
-                assert d < 0.1, f"flash GQA grad mismatch {kw}: {d}"
+            for name, a, b in zip(("dq", "dk", "dv"), gf, gx):
+                # Both sides are bf16: tolerance must scale with magnitude
+                # (dv reaches ~30 here; one bf16 ulp at 30 is 0.125, which a
+                # fixed 0.1 abs threshold mis-flagged as a kernel bug in the
+                # round-5 chip session — tools/debug_flash_gqa.py showed the
+                # kernel closer to fp32 than the oracle itself).
+                a = a.astype(jnp.float32)
+                b = b.astype(jnp.float32)
+                d = float(jnp.max(jnp.abs(a - b)))
+                tol = 0.01 * max(1.0, float(jnp.max(jnp.abs(b))))
+                assert d < tol, f"flash GQA {name} mismatch {kw}: {d} (tol {tol})"
 
     def sparse():
         from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig, FixedSparsityConfig, sparse_attention
@@ -172,11 +180,43 @@ def _ops():
                 err = float(jnp.max(jnp.abs(got.astype(jnp.float32) - ref.astype(jnp.float32))))
                 assert err < 0.25, (bits, m, err)
 
+    def ring():
+        # collapsed-KV ring attention (sequence/ring.py): pure-XLA
+        # (fori_loop + ppermute) but never TPU-compiled before round 5.
+        # One chip = a 1-member ring; validates the TPU lowering of the
+        # loop/permute/online-softmax structure and fwd+bwd parity.
+        from jax.sharding import Mesh
+
+        from deepspeed_tpu.ops.attention import attention_xla
+        from deepspeed_tpu.sequence.ring import ring_sharded_attention
+
+        B, S, H, D, KVH = 2, 512, 8, 64, 2
+        ks = jax.random.split(jax.random.PRNGKey(0), 3)
+        q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
+        k = jax.random.normal(ks[1], (B, S, KVH, D), jnp.bfloat16)
+        v = jax.random.normal(ks[2], (B, S, KVH, D), jnp.bfloat16)
+        mesh = Mesh(np.array(jax.devices()[:1]), ("context",))
+
+        def ring_loss(q, k, v):
+            return ring_sharded_attention(q, k, v, mesh).astype(jnp.float32).sum()
+
+        def ref_loss(q, k, v):
+            return attention_xla(q, k, v, causal=True).astype(jnp.float32).sum()
+
+        gr = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+        gx = jax.jit(jax.grad(ref_loss, argnums=(0, 1, 2)))(q, k, v)
+        for name, a, b in zip(("dq", "dk", "dv"), gr, gx):
+            a = a.astype(jnp.float32)
+            b = b.astype(jnp.float32)
+            d = float(jnp.max(jnp.abs(a - b)))
+            tol = 0.01 * max(1.0, float(jnp.max(jnp.abs(b))))
+            assert d < tol, f"ring {name} mismatch: {d} (tol {tol})"
+
     # order = priority: the round-4 rewrites that have never met real
     # Mosaic (GQA-collapsed flash fwd+bwd, partitioned qmm, sampled-burst
     # serve) run FIRST — chip windows die; spend the first minutes on the
     # kernels with zero hardware evidence (VERDICT r5 #1)
-    return {"flash": flash, "qmm": qmm, "serve": serve, "paged": paged,
+    return {"flash": flash, "qmm": qmm, "serve": serve, "ring": ring, "paged": paged,
             "sparse": sparse, "norms": norms, "optimizers": optimizers, "quant": quant}
 
 
