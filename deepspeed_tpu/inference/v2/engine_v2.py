@@ -45,6 +45,9 @@ class RaggedInferenceEngineConfig:
     dtype: str = "bfloat16"
     interpret_kernels: Optional[bool] = None  # Pallas interpret mode; default: on unless running on real TPU
     decode_burst: int = 32  # max fused greedy-decode steps per dispatch (0 disables bursting)
+    min_decode_bucket: int = 8  # floor for the padded decode batch: fewer compiled
+    # (B, steps) shapes (padded rows write to the garbage page, so a bigger
+    # bucket costs nothing real); 1 restores exact power-of-two bucketing
     # weight-only quantization (ref inference/quantization + mixed-GEMM):
     # matmul kernels stored int8-in-HBM, dequantized in-kernel per tile
     quant_bits: int = 0  # 0 = off; 8, or 4 (TRUE packed int4 storage, 2 codes/byte)
@@ -189,17 +192,21 @@ class InferenceEngineV2:
             self._bursts[key] = self._bursts.pop(key)
         return self._bursts[key]
 
-    def _choose_tokens(self, logits) -> np.ndarray:
+    def _choose_tokens_dev(self, logits):
         """Device-side token choice for (n, V) logits: argmax, or the shared
-        sampler during a sampling generate() — either way only n ints cross
-        the host boundary."""
+        sampler during a sampling generate(). Returns a DEVICE (n,) array —
+        callers that need host ints go through ``_choose_tokens``; the
+        deferred serving loop keeps the array on device instead."""
         if self._sampling is None:
-            return np.asarray(jnp.argmax(logits, axis=-1))
+            return jnp.argmax(logits, axis=-1)
         from ..generation import sample_logits
 
         do, t, k, p = self._sampling
         self._rng, r = jax.random.split(self._rng)
-        return np.asarray(sample_logits(logits, r, do, t, k, p))
+        return sample_logits(logits, r, do, t, k, p)
+
+    def _choose_tokens(self, logits) -> np.ndarray:
+        return np.asarray(self._choose_tokens_dev(logits))
 
     # ---------------------------------------------------------- feasibility
     def query(self, uid: int, max_request_length: int) -> Tuple[int, int]:
@@ -221,7 +228,7 @@ class InferenceEngineV2:
 
     # ---------------------------------------------------------- core step
     def put(self, batch_uids: Sequence[int], batch_tokens: Sequence[Sequence[int]],
-            return_tokens: bool = False) -> np.ndarray:
+            return_tokens: bool = False, _defer: bool = False):
         """Run one engine step over a ragged batch; returns next-token logits (B, V).
 
         Sequences with multiple tokens run as (chunked) prefill; known
@@ -231,6 +238,10 @@ class InferenceEngineV2:
         (~6 MB at batch 32 / 50k vocab) to B ints, which over a tunneled
         chip is the difference between readback-bound and compute-bound
         decode.
+
+        ``_defer`` (internal, serving loop): identical routing, but token
+        entries may be 0-d DEVICE arrays and the return is a list of
+        per-row device arrays — nothing syncs to the host.
         """
         if len(batch_uids) != len(batch_tokens):
             raise ValueError("uids and token lists must align")
@@ -240,7 +251,7 @@ class InferenceEngineV2:
             # scheduler never emits this; refuse instead of corrupting
             raise ValueError("duplicate uid in one put() batch: submit a sequence's chunks "
                              "in separate steps")
-        logits_by_idx: Dict[int, np.ndarray] = {}
+        logits_by_idx: Dict[int, object] = {}
 
         decode_idx: List[int] = []
         prefill_groups: Dict[int, List[int]] = {}  # padded length bucket -> indices
@@ -258,16 +269,25 @@ class InferenceEngineV2:
         for S, idxs in prefill_groups.items():
             rows = self._run_prefill_batch([batch_uids[i] for i in idxs],
                                            [list(batch_tokens[i]) for i in idxs], S,
-                                           return_tokens=return_tokens)
-            for i, row in zip(idxs, rows):
-                logits_by_idx[i] = row
+                                           return_tokens=return_tokens, defer=_defer)
+            for j, i in enumerate(idxs):
+                logits_by_idx[i] = rows[j]
 
         if decode_idx:
             uids = [batch_uids[i] for i in decode_idx]
-            toks = [int(batch_tokens[i][0]) for i in decode_idx]
-            out = self._run_decode(uids, toks, return_tokens=return_tokens)
-            for i, row in zip(decode_idx, out):
-                logits_by_idx[i] = row
+            carried = [batch_tokens[i][0] for i in decode_idx]
+            if _defer:
+                # device scalars (or host ints from a 1-token tail chunk)
+                # stack into the input ids without a host sync
+                ids_dev = self._ids_from_carry(carried, self._decode_bucket(len(uids)))
+                out = self._run_decode(uids, [0] * len(uids), return_tokens=return_tokens,
+                                       ids_dev=ids_dev, defer=True)
+            else:
+                out = self._run_decode(uids, [int(t) for t in carried], return_tokens=return_tokens)
+            for j, i in enumerate(decode_idx):
+                logits_by_idx[i] = out[j]
+        if _defer:
+            return [logits_by_idx[i] for i in range(len(batch_uids))]
         return np.stack([logits_by_idx[i] for i in range(len(batch_uids))])
 
     def flush(self, uids: Sequence[int]) -> None:
@@ -285,7 +305,7 @@ class InferenceEngineV2:
         return (self._garbage_block * self.state.block_size + np.arange(n) % self.state.block_size).astype(np.int32)
 
     def _run_prefill_batch(self, uids: List[int], token_lists: List[List[int]], S: int,
-                           return_tokens: bool = False) -> List[np.ndarray]:
+                           return_tokens: bool = False, defer: bool = False):
         """Prefill a bucket of sequence chunks (each possibly with prior
         context) in one dispatch; the batch dim pads to a power of two so
         the compile ladder stays logarithmic. Padded rows write their KV
@@ -336,11 +356,16 @@ class InferenceEngineV2:
                                                               jnp.asarray(last))
         for seq in seqs:
             seq.post_forward()
+        if defer:
+            return self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
         if return_tokens:
             out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         else:
             out = np.asarray(logits[:n])
         return [out[j] for j in range(n)]
+
+    def _decode_bucket(self, n: int) -> int:
+        return max(self._config.min_decode_bucket, _next_pow2(n))
 
     def _assemble_decode(self, uids: List[int], tokens: List[int], steps: int):
         """Shared decode-batch assembly for single steps and bursts.
@@ -350,7 +375,7 @@ class InferenceEngineV2:
         arrays; padded rows write every step's KV into the garbage page.
         """
         n = len(uids)
-        B = _next_pow2(n)
+        B = self._decode_bucket(n)
         bs = self.state.block_size
         ids = np.zeros((B, 1), np.int32)
         positions = np.zeros((B, 1), np.int32)
@@ -374,14 +399,26 @@ class InferenceEngineV2:
         last = np.zeros((B,), np.int32)
         return ids, positions, ctx, bt, slots, last, seqs, n
 
-    def _run_decode(self, uids: List[int], tokens: List[int], return_tokens: bool = False) -> np.ndarray:
+    def _ids_from_carry(self, carried, B: int):
+        """(B, 1) decode input ids from per-sequence DEVICE scalars — a
+        stack + pad that never touches the host (the deferred serving
+        loop's replacement for the ``ids[j, 0] = int(tok)`` host write)."""
+        n = len(carried)
+        col = jnp.stack([jnp.asarray(t, jnp.int32).reshape(()) for t in carried])
+        return jnp.zeros((B, 1), jnp.int32).at[:n, 0].set(col)
+
+    def _run_decode(self, uids: List[int], tokens: List[int], return_tokens: bool = False,
+                    ids_dev=None, defer: bool = False):
         ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps=1)
-        logits, self.k_pages, self.v_pages = self._decode_fn(self.params, jnp.asarray(ids), jnp.asarray(positions),
+        ids_in = ids_dev if ids_dev is not None else jnp.asarray(ids)
+        logits, self.k_pages, self.v_pages = self._decode_fn(self.params, ids_in, jnp.asarray(positions),
                                                              self.k_pages, self.v_pages, jnp.asarray(bt),
                                                              jnp.asarray(ctx), jnp.asarray(slots[0]),
                                                              jnp.asarray(last))
         for seq in seqs:
             seq.post_forward()
+        if defer:
+            return self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
         if return_tokens:
             return self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         return np.asarray(logits[:n])
@@ -407,15 +444,19 @@ class InferenceEngineV2:
             k //= 2
         return 0
 
-    def _run_decode_burst(self, uids: List[int], tokens: List[int], steps: int) -> np.ndarray:
+    def _run_decode_burst(self, uids: List[int], tokens: List[int], steps: int,
+                          ids_dev=None, defer: bool = False) -> np.ndarray:
         """``steps`` fused greedy-decode steps; returns (len(uids), steps) tokens."""
         ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps)
+        ids_in = ids_dev if ids_dev is not None else jnp.asarray(ids)
         self._rng, burst_rng = jax.random.split(self._rng)
         toks, self.k_pages, self.v_pages = self._burst_for(self._sampling)(
-            self.params, jnp.asarray(ids), jnp.asarray(positions), self.k_pages, self.v_pages,
+            self.params, ids_in, jnp.asarray(positions), self.k_pages, self.v_pages,
             jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last), burst_rng)
         for seq in seqs:
             seq.post_forward()
+        if defer:
+            return toks[:n]  # device (n, steps), no readback
         return np.asarray(toks[:n])
 
     # ---------------------------------------------------------- serving loop
@@ -448,10 +489,22 @@ class InferenceEngineV2:
             self._sampling = None
 
     def _generate(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
+        # Deferred mode: when nothing on the host needs token VALUES
+        # mid-stream (no EOS cut, no streaming callback), the scheduler's
+        # decisions depend only on counts and block accounting — so the
+        # inter-dispatch token carry stays ON DEVICE (decode_ready maps
+        # uid -> 0-d device array) and the only host sync in the whole
+        # generate is the final fetch. Over a tunneled chip each avoided
+        # readback is a ~100 ms roundtrip; the first on-chip serve capture
+        # (round 5) measured the synchronous loop 20x below the decode
+        # ceiling for exactly this reason.
+        deferred = eos_token_id is None and on_token is None
         reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
         pending = list(reqs.values())
-        decode_ready: Dict[int, int] = {}  # uid -> next token to feed
+        decode_ready: Dict[int, object] = {}  # uid -> next token to feed (int, or device scalar when deferred)
         results: Dict[int, List[int]] = {i: [] for i in reqs}
+        pieces: Dict[int, List[object]] = {i: [] for i in reqs}  # deferred: device arrays
+        counts: Dict[int, int] = {i: 0 for i in reqs}
 
         def commit(uid: int, toks_out: List[int]) -> None:
             """Record sampled tokens and retire/continue the request."""
@@ -471,6 +524,18 @@ class InferenceEngineV2:
             else:
                 decode_ready[uid] = toks_out[-1]
 
+        def commit_dev(uid: int, row) -> None:
+            """Deferred commit: ``row`` is a device (k,) or 0-d array."""
+            req = reqs[uid]
+            row = jnp.atleast_1d(row)
+            pieces[uid].append(row)
+            counts[uid] += int(row.shape[0])
+            if counts[uid] >= req.max_new_tokens:
+                req.done = True
+                self.flush([uid])
+            else:
+                decode_ready[uid] = row[-1]
+
         while pending or decode_ready:
             # Burst path: nothing left to admit and everyone is decoding —
             # run K fused steps on-device instead of K host roundtrips.
@@ -481,13 +546,21 @@ class InferenceEngineV2:
                 # one token per sequence, so both limits bound the batch
                 cap = min(self.scheduler.max_sequences, self.scheduler.max_batch_tokens)
                 burst_uids = list(decode_ready)[:cap]
-                rem = min(reqs[u].max_new_tokens - len(results[u]) for u in burst_uids)
-                k = self._burst_steps({u: decode_ready[u] for u in burst_uids}, rem)
+                done_count = counts if deferred else {u: len(results[u]) for u in burst_uids}
+                rem = min(reqs[u].max_new_tokens - done_count[u] for u in burst_uids)
+                k = self._burst_steps({u: True for u in burst_uids}, rem)
                 if k >= 2:
                     uids = burst_uids
-                    out = self._run_decode_burst(uids, [decode_ready.pop(u) for u in uids], k)
-                    for uid, row in zip(uids, out):
-                        commit(uid, row.tolist())
+                    carried = [decode_ready.pop(u) for u in uids]
+                    if deferred:
+                        ids_dev = self._ids_from_carry(carried, self._decode_bucket(len(uids)))
+                        out = self._run_decode_burst(uids, [0] * len(uids), k, ids_dev=ids_dev, defer=True)
+                        for uid, row in zip(uids, out):
+                            commit_dev(uid, row)
+                    else:
+                        out = self._run_decode_burst(uids, carried, k)
+                        for uid, row in zip(uids, out):
+                            commit(uid, row.tolist())
                     continue
             step = self.scheduler.schedule([r for r in pending if r.remaining_prefill], list(decode_ready))
             if step.empty:
@@ -501,10 +574,23 @@ class InferenceEngineV2:
                 uids.append(pf.uid)
                 toks.append(pf.tokens)
                 req.tokens = req.tokens[len(pf.tokens):]
-            nxt = self.put(uids, toks, return_tokens=True)
+            nxt = self.put(uids, toks, return_tokens=True, _defer=deferred)
             for uid, tok in zip(uids, nxt):
                 if reqs[uid].remaining_prefill:
                     continue  # mid-prefill chunk: logits not a sampled token yet
-                commit(uid, [int(tok)])
+                if deferred:
+                    commit_dev(uid, tok)
+                else:
+                    commit(uid, [int(tok)])
             pending = [r for r in pending if not r.done and r.remaining_prefill]
-        return [results[i] for i in range(len(prompts))]
+
+        if not deferred:
+            return [results[i] for i in range(len(prompts))]
+        # one fetch for everything: equal lengths (no EOS) stack into a
+        # single (n_prompts, max_new_tokens) transfer
+        rows = [jnp.concatenate(pieces[i]) if len(pieces[i]) > 1 else pieces[i][0] for i in range(len(prompts))]
+        lens = {int(r.shape[0]) for r in rows}
+        if len(lens) == 1:
+            arr = np.asarray(jnp.stack(rows))
+            return [arr[i].tolist() for i in range(len(prompts))]
+        return [np.asarray(r).tolist() for r in rows]

@@ -33,8 +33,8 @@ def main():
     B, S, H, D, KVH = 2, 512, 8, 64, 2
     ks = jax.random.split(jax.random.PRNGKey(0), 3)
     q = jax.random.normal(ks[0], (B, S, H, D), jnp.bfloat16)
-    kg = jax.random.normal(ks[0], (B, S, KVH, D), jnp.bfloat16)
-    vg = jax.random.normal(ks[1], (B, S, KVH, D), jnp.bfloat16)
+    kg = jax.random.normal(ks[1], (B, S, KVH, D), jnp.bfloat16)
+    vg = jax.random.normal(ks[2], (B, S, KVH, D), jnp.bfloat16)
     slopes = np.geomspace(0.25, 0.001, H).astype(np.float32)
 
     for kw in ({}, {"alibi_slopes": slopes}, {"window": 128}):

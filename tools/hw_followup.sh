@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-5 follow-up once hw_session completes: clean smoke record for the
+# two re-checked kernels (flash tolerance fix + new ring case), then
+# re-capture the serve rung with the deferred (device-carry) serving loop
+# and refresh the SLA table. Run AFTER tools/hw_session.sh finishes.
+cd "$(dirname "$0")/.." || exit 1
+LOG=${1:-hw_followup.log}
+: > "$LOG"
+
+note() { echo "[hw_followup $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
+
+note "health check"
+if ! timeout 110 python -c "
+import jax, jax.numpy as jnp
+print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$LOG" 2>&1; then
+    note "tunnel DEAD - aborting"
+    exit 1
+fi
+
+note "1/3 hw_smoke flash+ring (scale-aware tolerance, ring first TPU compile)"
+timeout 1200 python tools/hw_smoke.py flash ring >> "$LOG" 2>&1
+note "smoke rc=$?"
+
+note "2/3 serve rung with deferred serving loop"
+DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1800 python bench.py >> "$LOG" 2>&1
+note "serve rc=$?"
+
+note "3/4 serve_sla re-capture (compile cache warm from the killed session run)"
+DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve_sla timeout 2400 python bench.py >> "$LOG" 2>&1
+note "serve_sla rc=$?"
+
+note "4/4 attention + longctx rungs (lost to the session bench timeout)"
+for rung in attn attn_d64 longctx; do
+    DS_BENCH_EXTRA=0 DS_BENCH_RUNG=$rung timeout 1500 python bench.py >> "$LOG" 2>&1
+    note "$rung rc=$?"
+done
+
+python tools/hw_summary.py > HW_SUMMARY.txt 2>&1
+note "follow-up complete"

@@ -39,7 +39,7 @@ def _ops():
         # full grads, parity vs the XLA oracle on-chip
         from deepspeed_tpu.ops.attention import attention_xla
 
-        kg, vg = (jax.random.normal(kk, (B, S, 2, D), jnp.bfloat16) for kk in ks[:2])
+        kg, vg = (jax.random.normal(kk, (B, S, 2, D), jnp.bfloat16) for kk in ks[1:3])
         for kw in ({}, {"alibi_slopes": slopes}, {"window": 128}):
             gf = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True, **kw)
                                   .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
