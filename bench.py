@@ -584,7 +584,25 @@ def main():
                 print(f"[bench] extra rung {other} failed: {type(e).__name__}: {e}", file=sys.stderr)
             flush_extra()
         print(f"[bench] wrote {path}", file=sys.stderr)
+    _dump_telemetry(rung)
     return 0
+
+
+def _dump_telemetry(rung):
+    """Snapshot the in-process telemetry registry next to the BENCH_*.json
+    artifacts — step counters, comm bytes, TTFT/TPOT histograms from the
+    serve rungs — so a bench run leaves its metrics, not just its headline."""
+    try:
+        from deepspeed_tpu.telemetry import get_registry
+
+        snap = get_registry().snapshot()
+        snap["rung"] = rung
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TELEMETRY.json")
+        with open(path, "w") as f:
+            json.dump(snap, f, indent=1, sort_keys=True)
+        print(f"[bench] wrote {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] telemetry dump failed: {type(e).__name__}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

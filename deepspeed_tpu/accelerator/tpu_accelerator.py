@@ -123,14 +123,18 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         pass
 
     def range_push(self, msg: str):
-        self._trace_ctx = jax.profiler.TraceAnnotation(msg)
-        self._trace_ctx.__enter__()
+        # stack, not a slot: telemetry spans nest (train/step > train/forward)
+        ctx = jax.profiler.TraceAnnotation(msg)
+        ctx.__enter__()
+        stack = getattr(self, "_trace_ctx_stack", None)
+        if stack is None:
+            stack = self._trace_ctx_stack = []
+        stack.append(ctx)
 
     def range_pop(self):
-        ctx = getattr(self, "_trace_ctx", None)
-        if ctx is not None:
-            ctx.__exit__(None, None, None)
-            self._trace_ctx = None
+        stack = getattr(self, "_trace_ctx_stack", None)
+        if stack:
+            stack.pop().__exit__(None, None, None)
 
     # --- graph capture (reference: CUDA graphs; TPU: jit IS the graph) ---
     def device_supports_graphs(self) -> bool:

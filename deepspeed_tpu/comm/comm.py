@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..telemetry.registry import get_registry
 from ..utils.comms_logging import CommsLogger, get_caller_func
 from ..utils.logging import logger
 from .reduce_op import ReduceOp
@@ -162,12 +163,24 @@ def log_summary(show_straggler: bool = False):
 
 
 def _timed(raw_name):
-    """Telemetry wrapper — reference ``timed_op`` (``comm.py:101``)."""
+    """Telemetry wrapper — reference ``timed_op`` (``comm.py:101``).
+
+    Op/byte counters fire on EVERY call (two float adds on handles bound
+    at decoration time — always-on is affordable). Latency/bandwidth need
+    a device sync, so they stay behind ``comms_logger.should_profile``
+    and flow through ``CommsLogger.append``, which forwards them to the
+    registry (``comm_latency_seconds``, ``comm_algbw_gbps``, ...)."""
 
     def deco(fn):
+        _m_ops = get_registry().counter("comm_ops_total", op=raw_name)
+        _m_bytes = get_registry().counter("comm_bytes_total", op=raw_name)
+
         @functools.wraps(fn)
         def wrapper(tensor, *args, **kwargs):
             log_name = kwargs.pop("log_name", raw_name)
+            msg = int(getattr(tensor, "size", 0)) * int(getattr(tensor, "dtype", jnp.float32).itemsize)
+            _m_ops.inc()
+            _m_bytes.inc(msg)
             prof = comms_logger.should_profile(raw_name)
             if not prof:
                 return fn(tensor, *args, **kwargs)
@@ -175,7 +188,6 @@ def _timed(raw_name):
             result = fn(tensor, *args, **kwargs)
             jax.block_until_ready(result)
             dt = time.perf_counter() - t0
-            msg = int(getattr(tensor, "size", 0)) * int(getattr(tensor, "dtype", jnp.float32).itemsize)
             n = kwargs.get("group_size") or _leading_group_size(tensor)
             record = f"{log_name} | [Caller Func: {get_caller_func(2)}]" if comms_logger.debug else log_name
             comms_logger.append(raw_name, record, dt, msg, n)

@@ -24,6 +24,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import TransformerConfig
+from ...telemetry import get_registry as get_telemetry_registry
+from ...telemetry import span as telemetry_span
 from ...utils.logging import log_dist, logger
 from .model_runner import make_burst_fn, make_step_fns
 from .ragged.manager import DSStateManager, RaggedBatchConfig
@@ -125,6 +127,16 @@ class InferenceEngineV2:
         self.state = DSStateManager(smc, n_blocks)
         self.scheduler = RaggedBatchScheduler(self.state, max_batch_tokens=smc.max_ragged_batch_size,
                                               max_sequences=smc.max_ragged_sequence_count)
+
+        # --- telemetry (docs/OBSERVABILITY.md) ---
+        tele = get_telemetry_registry()
+        self._m_requests = tele.counter("infer_requests_total")
+        self._m_prefill_tokens = tele.counter("infer_prefill_tokens_total")
+        self._m_decode_tokens = tele.counter("infer_decode_tokens_total")
+        self._m_decode_steps = tele.counter("infer_decode_steps_total")
+        self._m_bursts = tele.counter("infer_decode_bursts_total")
+        self._m_decode_fill = tele.gauge("infer_decode_batch_fill")
+        self._m_prefill_fill = tele.gauge("infer_prefill_batch_fill")
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -350,10 +362,14 @@ class InferenceEngineV2:
             last[j] = m - 1
             seqs.append(seq)
 
-        logits, self.k_pages, self.v_pages = self._prefill_fn(self.params, jnp.asarray(ids), jnp.asarray(positions),
-                                                              self.k_pages, self.v_pages, jnp.asarray(bt),
-                                                              jnp.asarray(ctx), jnp.asarray(slots.reshape(-1)),
-                                                              jnp.asarray(last))
+        with telemetry_span("infer/prefill", bucket=S, rows=n):
+            logits, self.k_pages, self.v_pages = self._prefill_fn(self.params, jnp.asarray(ids),
+                                                                  jnp.asarray(positions),
+                                                                  self.k_pages, self.v_pages, jnp.asarray(bt),
+                                                                  jnp.asarray(ctx), jnp.asarray(slots.reshape(-1)),
+                                                                  jnp.asarray(last))
+        self._m_prefill_tokens.inc(sum(len(t) for t in token_lists))
+        self._m_prefill_fill.set(n / B)
         for seq in seqs:
             seq.post_forward()
         if defer:
@@ -411,10 +427,14 @@ class InferenceEngineV2:
                     ids_dev=None, defer: bool = False):
         ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps=1)
         ids_in = ids_dev if ids_dev is not None else jnp.asarray(ids)
-        logits, self.k_pages, self.v_pages = self._decode_fn(self.params, ids_in, jnp.asarray(positions),
-                                                             self.k_pages, self.v_pages, jnp.asarray(bt),
-                                                             jnp.asarray(ctx), jnp.asarray(slots[0]),
-                                                             jnp.asarray(last))
+        with telemetry_span("infer/decode", rows=n):
+            logits, self.k_pages, self.v_pages = self._decode_fn(self.params, ids_in, jnp.asarray(positions),
+                                                                 self.k_pages, self.v_pages, jnp.asarray(bt),
+                                                                 jnp.asarray(ctx), jnp.asarray(slots[0]),
+                                                                 jnp.asarray(last))
+        self._m_decode_steps.inc()
+        self._m_decode_tokens.inc(n)
+        self._m_decode_fill.set(n / len(ctx))
         for seq in seqs:
             seq.post_forward()
         if defer:
@@ -450,9 +470,14 @@ class InferenceEngineV2:
         ids, positions, ctx, bt, slots, last, seqs, n = self._assemble_decode(uids, tokens, steps)
         ids_in = ids_dev if ids_dev is not None else jnp.asarray(ids)
         self._rng, burst_rng = jax.random.split(self._rng)
-        toks, self.k_pages, self.v_pages = self._burst_for(self._sampling)(
-            self.params, ids_in, jnp.asarray(positions), self.k_pages, self.v_pages,
-            jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last), burst_rng)
+        with telemetry_span("infer/decode_burst", rows=n, steps=steps):
+            toks, self.k_pages, self.v_pages = self._burst_for(self._sampling)(
+                self.params, ids_in, jnp.asarray(positions), self.k_pages, self.v_pages,
+                jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last), burst_rng)
+        self._m_bursts.inc()
+        self._m_decode_steps.inc(steps)
+        self._m_decode_tokens.inc(n * steps)
+        self._m_decode_fill.set(n / len(ctx))
         for seq in seqs:
             seq.post_forward()
         if defer:
@@ -483,6 +508,7 @@ class InferenceEngineV2:
         """
         self._sampling = (True, float(temperature), int(top_k), float(top_p)) if do_sample else None
         self._rng = jax.random.PRNGKey(seed)
+        self._m_requests.inc(len(prompts))
         try:
             return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
         finally:

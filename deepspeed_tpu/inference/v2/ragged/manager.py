@@ -10,6 +10,7 @@ per-layer page arrays updated functionally under jit with donation).
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+from ....telemetry import get_registry as get_telemetry_registry
 from ....utils.logging import logger
 from .blocked_allocator import BlockedAllocator
 from .sequence_descriptor import DSSequenceDescriptor
@@ -33,6 +34,23 @@ class DSStateManager:
         self._config = config
         self._allocator = BlockedAllocator(num_kv_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        # occupancy gauges track the most recently constructed manager
+        # (one serving engine per process in practice)
+        tele = get_telemetry_registry()
+        self._m_free = tele.gauge("kv_blocks_free")
+        self._m_occupancy = tele.gauge("kv_block_occupancy")
+        self._m_tracked = tele.gauge("kv_tracked_sequences")
+        self._m_allocated = tele.counter("kv_blocks_allocated_total")
+        self._m_flushed = tele.counter("kv_sequences_flushed_total")
+        tele.gauge("kv_blocks_total").set(num_kv_blocks)
+        self._sync_gauges()
+
+    def _sync_gauges(self) -> None:
+        free = self._allocator.free_blocks
+        total = max(1, self._allocator.total_blocks)
+        self._m_free.set(free)
+        self._m_occupancy.set(1.0 - free / total)
+        self._m_tracked.set(len(self._seqs))
 
     @property
     def block_size(self) -> int:
@@ -76,6 +94,8 @@ class DSStateManager:
         need = seq.blocks_needed(new_tokens)
         if need:
             seq.extend_blocks(self._allocator.allocate(need))
+            self._m_allocated.inc(need)
+            self._sync_gauges()
 
     def can_allocate(self, num_blocks: int) -> bool:
         return num_blocks <= self._allocator.free_blocks
@@ -88,6 +108,8 @@ class DSStateManager:
             return
         if seq.blocks:
             self._allocator.free(seq.blocks)
+        self._m_flushed.inc()
+        self._sync_gauges()
 
     def flush_all(self) -> None:
         for uid in list(self._seqs):

@@ -12,6 +12,7 @@ the budget), in the style of the FastGen "Dynamic SplitFuse" scheduler
 from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
+from ...telemetry import get_registry as get_telemetry_registry
 from .ragged.manager import DSStateManager
 
 
@@ -53,6 +54,11 @@ class RaggedBatchScheduler:
         self.max_batch_tokens = max_batch_tokens
         self.max_sequences = max_sequences
         self.prefill_chunk = prefill_chunk
+        tele = get_telemetry_registry()
+        self._m_queue_depth = tele.gauge("sched_queue_depth")
+        self._m_step_tokens = tele.gauge("sched_step_tokens")
+        self._m_decodes = tele.counter("sched_decodes_total")
+        self._m_prefill_chunks = tele.counter("sched_prefill_chunks_total")
 
     def schedule(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> ScheduledStep:
         """Pick the work for one engine step.
@@ -96,4 +102,8 @@ class RaggedBatchScheduler:
             seqs += 1
             prefills.append(ScheduledPrefill(uid=req.uid, tokens=req.tokens[:take], start_pos=seq.seen_tokens))
 
+        self._m_queue_depth.set(len(pending_prefills))
+        self._m_step_tokens.set(self.max_batch_tokens - budget)
+        self._m_decodes.inc(len(sched_decodes))
+        self._m_prefill_chunks.inc(len(prefills))
         return ScheduledStep(prefills=prefills, decode_uids=sched_decodes)

@@ -23,7 +23,14 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ...telemetry import get_registry as get_telemetry_registry
 from .scheduler import RaggedRequest
+
+# SLA-shaped buckets: the FastGen streaming SLA (TTFT <= 1 s,
+# TPOT <= 250 ms) falls on bucket edges so miss fractions read directly
+# off the cumulative counts
+TTFT_BUCKETS = (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0)
+TPOT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
 
 
 @dataclasses.dataclass
@@ -77,6 +84,9 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
     results: Dict[int, List[int]] = {}
     next_idx = 0
     engine._sampling = None
+    tele = get_telemetry_registry()
+    h_ttft = tele.histogram("infer_ttft_seconds", buckets=TTFT_BUCKETS)
+    h_tpot = tele.histogram("infer_tpot_seconds", buckets=TPOT_BUCKETS)
 
     t0 = time.perf_counter()
 
@@ -102,6 +112,7 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
         t = now()
         if not results[uid]:
             stats[uid].first_token = t
+            h_ttft.observe(t - stats[uid].arrival)
         results[uid].extend(toks_out)
         stats[uid].n_new = len(results[uid])
         finished = (len(results[uid]) >= req.max_new_tokens or
@@ -109,6 +120,8 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
         if finished:
             req.done = True
             stats[uid].done = t
+            if stats[uid].n_new > 1:
+                h_tpot.observe(stats[uid].tpot)
             engine.flush([uid])
         else:
             decode_ready[uid] = toks_out[-1]

@@ -1,3 +1,3 @@
-from .monitor import MonitorMaster
+from .monitor import CsvMonitor, MonitorMaster, TensorBoardMonitor, WandbMonitor, csvMonitor
 
-__all__ = ["MonitorMaster"]
+__all__ = ["MonitorMaster", "CsvMonitor", "csvMonitor", "TensorBoardMonitor", "WandbMonitor"]

@@ -67,7 +67,7 @@ class WandbMonitor(Monitor):
             self._wandb.log({name: value}, step=step)
 
 
-class csvMonitor(Monitor):
+class CsvMonitor(Monitor):
     def __init__(self, config):
         super().__init__(config)
         self.filepaths = {}
@@ -90,16 +90,20 @@ class csvMonitor(Monitor):
                 w.writerow([step, value])
 
 
+# reference spelling (deepspeed/monitor/csv_monitor.py); kept importable
+csvMonitor = CsvMonitor
+
+
 class MonitorMaster(Monitor):
     def __init__(self, ds_config):
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
-        self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self.csv_monitor = CsvMonitor(ds_config.csv_monitor)
         self.enabled = self.tb_monitor.enabled or self.wandb_monitor.enabled or self.csv_monitor.enabled
 
     def write_events(self, events: List[Event]):
-        if jax.process_index() != 0:
+        if not self.enabled or jax.process_index() != 0:
             return
         for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
-            if m.enabled:
+            if m is not None and getattr(m, "enabled", False):
                 m.write_events(events)

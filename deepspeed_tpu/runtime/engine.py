@@ -22,6 +22,7 @@ fp16 with overflow-skip (``stage_1_and_2.py:1995``).
 
 import json
 import os
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -32,6 +33,9 @@ import optax
 from .. import comm as dist
 from ..accelerator import get_accelerator
 from ..parallel.mesh import MeshTopology, get_mesh_topology, initialize_mesh
+from ..telemetry import MonitorBridge
+from ..telemetry import get_registry as get_telemetry_registry
+from ..telemetry import span as telemetry_span
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER, NoopTimer,
                            SynchronizedWallClockTimer, ThroughputTimer, TRAIN_BATCH_TIMER)
@@ -68,6 +72,16 @@ def _global_norm(tree):
 def _all_finite(tree):
     leaves = [jnp.all(jnp.isfinite(x)) for x in jax.tree_util.tree_leaves(tree)]
     return jnp.all(jnp.stack(leaves))
+
+
+def _batch_tokens(batch) -> int:
+    """Token count of a microbatch from shape metadata only (never reads
+    device data, so it is safe on the dispatch path)."""
+    ids = batch.get("input_ids") if isinstance(batch, dict) else None
+    shape = getattr(ids, "shape", None)
+    if shape is not None and len(shape) >= 2:
+        return int(shape[0]) * int(shape[1])
+    return 0
 
 
 class DeepSpeedEngine:
@@ -229,6 +243,30 @@ class DeepSpeedEngine:
         self.checkpoint_engine = create_checkpoint_engine(self.config)
         self.monitor = self._configure_monitor()
         self.flops_profiler = None  # built lazily at the configured profile step
+
+        # --- telemetry (docs/OBSERVABILITY.md) ---
+        # handles resolved once; per-step cost is attribute checks + float
+        # adds. Gauges that need a device->host sync (loss, grad norm) are
+        # only set where a sync already happens (_report / monitor flush).
+        tele = get_telemetry_registry()
+        self.telemetry = tele
+        self._m_steps = tele.counter("train_steps_total")
+        self._m_micro = tele.counter("train_microbatches_total")
+        self._m_samples = tele.counter("train_samples_total")
+        self._m_tokens = tele.counter("train_tokens_total")
+        self._m_overflow = tele.counter("train_overflow_steps_total")
+        self._m_loss_scale = tele.gauge("train_loss_scale")
+        self._m_lr = tele.gauge("train_lr")
+        self._m_loss = tele.gauge("train_loss")
+        self._m_gnorm = tele.gauge("train_grad_norm")
+        self._m_tps = tele.gauge("train_tokens_per_sec")
+        self._m_heartbeat = tele.gauge("last_step_completed_unix")
+        self._m_grad_sync_bytes = tele.counter("comm_bytes_total", op="grad_sync_estimated")
+        self._last_microbatch_tokens = 0
+        self._last_step_pc = None
+        self._monitor_bridge = MonitorBridge(
+            tele, self.monitor,
+            every_n_steps=int(os.environ.get("DS_TPU_TELEMETRY_FLUSH_STEPS", "1")))
 
         # legacy curriculum learning (reference engine.py:1821-1833): the
         # scheduler's difficulty is a sequence length; forward() truncates
@@ -453,6 +491,18 @@ class DeepSpeedEngine:
 
         self._eval_loss = jax.jit(eval_loss)
 
+        # Per-step gradient-reduction traffic estimate. GSPMD inserts the
+        # data-parallel grad collectives inside the compiled step, so the
+        # eager comm façade never sees them; this dispatch-side estimate
+        # (full grad tree, accumulation dtype) keeps comm_bytes_total
+        # meaningful for compiled training.
+        dp = self.topology.data_parallel_size
+        if dp > 1:
+            n_grad_elems = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(self.params))
+            self._grad_sync_bytes = n_grad_elems * jnp.dtype(self._grad_acc_dtype).itemsize
+        else:
+            self._grad_sync_bytes = 0
+
         if self._param_offload == "eager":
             # engine-level swap: async device_put of the host store before
             # each compiled call, updated params put back after (the
@@ -532,36 +582,38 @@ class DeepSpeedEngine:
             # not leave the forward timer running across the exception
             raise RuntimeError("fused_step: forward() called again before step() consumed the previous one")
         self.timers(FORWARD_GLOBAL_TIMER).start()
-        if self.curriculum_scheduler is not None:
-            batch = self._apply_curriculum(batch)
-        if self.progressive_layer_drop is not None and isinstance(batch, dict):
-            # traced scalar, not a python float: theta changes every step
-            # and must not retrigger compilation
-            batch = dict(batch)
-            batch["pld_theta"] = np.asarray(self.progressive_layer_drop.get_theta(), np.float32)
-        batch = self._put_batch(batch)
-        scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
-        profiling = (self.config.flops_profiler.enabled
-                     and self.global_steps == self.config.flops_profiler.profile_step
-                     and (self.micro_steps - self._accum_base) % self.gradient_accumulation_steps == 0)  # first micro-batch only
-        if profiling:
-            self._start_flops_profile(batch, self.micro_steps, scale)
-        if (self._fused_step is not None and self.gradient_accumulation_steps == 1
-                and not profiling and getattr(self, "_training", True)):
-            lr = self._next_lr()
-            inv_scale = 1.0 / self.loss_scaler.loss_scale
-            loss, self.params, self.opt_state, gnorm, overflow = self._fused_step(
-                self.params, self.opt_state, batch, self.micro_steps, scale, inv_scale, lr)
-            self._fused_pending = (gnorm, overflow, lr)
-            self._cached_grads = _FUSED
-        else:
-            loss, grads = self._fwd_bwd(self.params, batch, self.micro_steps, scale)
-            self._cached_grads = grads
-        self._last_loss = loss
-        if self.eigenvalue is not None:
-            self._last_batch = batch  # retained for the gas-boundary eigenvalue pass
-        if profiling:
-            self._stop_flops_profile()
+        with telemetry_span("train/forward"):
+            if self.curriculum_scheduler is not None:
+                batch = self._apply_curriculum(batch)
+            if self.progressive_layer_drop is not None and isinstance(batch, dict):
+                # traced scalar, not a python float: theta changes every step
+                # and must not retrigger compilation
+                batch = dict(batch)
+                batch["pld_theta"] = np.asarray(self.progressive_layer_drop.get_theta(), np.float32)
+            self._last_microbatch_tokens = _batch_tokens(batch)
+            batch = self._put_batch(batch)
+            scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
+            profiling = (self.config.flops_profiler.enabled
+                         and self.global_steps == self.config.flops_profiler.profile_step
+                         and (self.micro_steps - self._accum_base) % self.gradient_accumulation_steps == 0)  # first micro-batch only
+            if profiling:
+                self._start_flops_profile(batch, self.micro_steps, scale)
+            if (self._fused_step is not None and self.gradient_accumulation_steps == 1
+                    and not profiling and getattr(self, "_training", True)):
+                lr = self._next_lr()
+                inv_scale = 1.0 / self.loss_scaler.loss_scale
+                loss, self.params, self.opt_state, gnorm, overflow = self._fused_step(
+                    self.params, self.opt_state, batch, self.micro_steps, scale, inv_scale, lr)
+                self._fused_pending = (gnorm, overflow, lr)
+                self._cached_grads = _FUSED
+            else:
+                loss, grads = self._fwd_bwd(self.params, batch, self.micro_steps, scale)
+                self._cached_grads = grads
+            self._last_loss = loss
+            if self.eigenvalue is not None:
+                self._last_batch = batch  # retained for the gas-boundary eigenvalue pass
+            if profiling:
+                self._stop_flops_profile()
         self.timers(FORWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -572,16 +624,21 @@ class DeepSpeedEngine:
         if self._cached_grads is None:
             raise RuntimeError("backward() called without a preceding forward()")
         self.timers(BACKWARD_GLOBAL_TIMER).start()
-        if self._cached_grads is _FUSED:
-            pass  # grads were consumed inside the fused forward dispatch
-        elif self._grad_acc is None:
-            self._grad_acc = self._cached_grads if self._to_acc_dtype is None \
-                else self._to_acc_dtype(self._cached_grads)
-        else:
-            self._grad_acc = self._accumulate(self._grad_acc, self._cached_grads)
-        self._cached_grads = None
-        self.micro_steps += 1
-        self.global_samples += self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
+        with telemetry_span("train/backward"):
+            if self._cached_grads is _FUSED:
+                pass  # grads were consumed inside the fused forward dispatch
+            elif self._grad_acc is None:
+                self._grad_acc = self._cached_grads if self._to_acc_dtype is None \
+                    else self._to_acc_dtype(self._cached_grads)
+            else:
+                self._grad_acc = self._accumulate(self._grad_acc, self._cached_grads)
+            self._cached_grads = None
+            self.micro_steps += 1
+            self.global_samples += self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size
+            self._m_micro.inc()
+            self._m_samples.inc(self.train_micro_batch_size_per_gpu * self.topology.data_parallel_size)
+            if self._last_microbatch_tokens:
+                self._m_tokens.inc(self._last_microbatch_tokens)
         self.timers(BACKWARD_GLOBAL_TIMER).stop()
         return loss
 
@@ -595,69 +652,92 @@ class DeepSpeedEngine:
             self._last_overflow = None  # no-op step (reference was_step_applied contract)
             return
         self.timers(STEP_GLOBAL_TIMER).start()
-        if (self.eigenvalue is not None
-                and self.global_steps % self.eigenvalue.gas_boundary_resolution == 0
-                and getattr(self, "_last_batch", None) is not None):
-            # curvature signal at the accumulation boundary (ref engine.py:2029).
-            # _loss_fn is a stable bound callable, so the per-layer HVP jits
-            # compile once; the step-derived rng feeds dropout-style losses.
-            params_c = _cast_tree(self.params, self.compute_dtype)
-            self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
-                self._loss_fn, params_c, self._last_batch,
-                loss_rng=jax.random.fold_in(self._rng, self.global_steps))
-        if self._fused_pending is not None:
-            # params/opt_state were installed by the fused forward dispatch
-            gnorm, overflow, lr = self._fused_pending
-            self._fused_pending = None
-        else:
-            lr = self._next_lr()
-            # grads were pre-scaled by loss_scale/gas in forward; undo loss_scale
-            # here (the 1/gas factor stays: summed micro-grads become the mean)
-            inv_scale = 1.0 / self.loss_scaler.loss_scale
-            if self._host_offload is not None:
-                new_params, gnorm, overflow = self._host_offload.step(jax.device_get(self._grad_acc), lr,
-                                                                      inv_scale=inv_scale,
-                                                                      grad_clip=self.config.gradient_clipping,
-                                                                      shardings=self.param_store_shardings)
-                if not overflow:
-                    self.params = new_params
+        with telemetry_span("train/step"):
+            if (self.eigenvalue is not None
+                    and self.global_steps % self.eigenvalue.gas_boundary_resolution == 0
+                    and getattr(self, "_last_batch", None) is not None):
+                # curvature signal at the accumulation boundary (ref engine.py:2029).
+                # _loss_fn is a stable bound callable, so the per-layer HVP jits
+                # compile once; the step-derived rng feeds dropout-style losses.
+                params_c = _cast_tree(self.params, self.compute_dtype)
+                self.block_eigenvalue = self.eigenvalue.compute_eigenvalue(
+                    self._loss_fn, params_c, self._last_batch,
+                    loss_rng=jax.random.fold_in(self._rng, self.global_steps))
+            if self._fused_pending is not None:
+                # params/opt_state were installed by the fused forward dispatch
+                gnorm, overflow, lr = self._fused_pending
+                self._fused_pending = None
             else:
-                self.params, self.opt_state, gnorm, overflow = self._apply_updates(
-                    self.params, self.opt_state, self._grad_acc, inv_scale, lr)
-        self._grad_acc = None
-        self._global_grad_norm = gnorm
-        self._last_overflow = overflow
-        if self.loss_scaler.dynamic or self._host_offload is not None:
-            # dynamic fp16 scaling needs the overflow bit on the host NOW
-            # (the scale feeds the next step) — this device->host sync is
-            # inherent to the algorithm, as in the reference
-            overflow_host = bool(overflow)
-            self.loss_scaler.update_scale(overflow_host)
-            if overflow_host:
-                self._skipped_host += 1
-                log_dist(f"step {self.global_steps}: grad overflow — step skipped, "
-                         f"loss scale -> {self.loss_scaler.loss_scale}", ranks=[0])
-        else:
-            # static scale (bf16/fp32): never block the dispatch pipeline on a
-            # per-step device->host readback (over a remote tunnel one scalar
-            # sync costs ~100ms). The skip-on-overflow happens in-graph;
-            # the counter folds lazily (see skipped_steps property).
-            self._skipped_dev = overflow.astype(jnp.int32) if self._skipped_dev is None \
-                else self._skipped_dev + overflow.astype(jnp.int32)
-        self.global_steps += 1
-        if self.random_ltd_scheduler is not None:
-            self.random_ltd_scheduler.update_seq(self.global_steps)
-        if self.progressive_layer_drop is not None:
-            self.progressive_layer_drop.update_state(self.global_steps)
-        if self.compression_engine is not None:
-            self.compression_engine.scheduler.step()
+                lr = self._next_lr()
+                # grads were pre-scaled by loss_scale/gas in forward; undo loss_scale
+                # here (the 1/gas factor stays: summed micro-grads become the mean)
+                inv_scale = 1.0 / self.loss_scaler.loss_scale
+                if self._host_offload is not None:
+                    new_params, gnorm, overflow = self._host_offload.step(jax.device_get(self._grad_acc), lr,
+                                                                          inv_scale=inv_scale,
+                                                                          grad_clip=self.config.gradient_clipping,
+                                                                          shardings=self.param_store_shardings)
+                    if not overflow:
+                        self.params = new_params
+                else:
+                    self.params, self.opt_state, gnorm, overflow = self._apply_updates(
+                        self.params, self.opt_state, self._grad_acc, inv_scale, lr)
+            self._grad_acc = None
+            self._global_grad_norm = gnorm
+            self._last_overflow = overflow
+            if self.loss_scaler.dynamic or self._host_offload is not None:
+                # dynamic fp16 scaling needs the overflow bit on the host NOW
+                # (the scale feeds the next step) — this device->host sync is
+                # inherent to the algorithm, as in the reference
+                overflow_host = bool(overflow)
+                self.loss_scaler.update_scale(overflow_host)
+                if overflow_host:
+                    self._skipped_host += 1
+                    self._m_overflow.inc()
+                    log_dist(f"step {self.global_steps}: grad overflow — step skipped, "
+                             f"loss scale -> {self.loss_scaler.loss_scale}", ranks=[0])
+            else:
+                # static scale (bf16/fp32): never block the dispatch pipeline on a
+                # per-step device->host readback (over a remote tunnel one scalar
+                # sync costs ~100ms). The skip-on-overflow happens in-graph;
+                # the counter folds lazily (see skipped_steps property).
+                self._skipped_dev = overflow.astype(jnp.int32) if self._skipped_dev is None \
+                    else self._skipped_dev + overflow.astype(jnp.int32)
+            self.global_steps += 1
+            if self.random_ltd_scheduler is not None:
+                self.random_ltd_scheduler.update_seq(self.global_steps)
+            if self.progressive_layer_drop is not None:
+                self.progressive_layer_drop.update_state(self.global_steps)
+            if self.compression_engine is not None:
+                self.compression_engine.scheduler.step()
         self.timers(STEP_GLOBAL_TIMER).stop()
+        # dispatch-boundary telemetry: counters, gauges, heartbeat. No device
+        # reads here — loss/grad-norm gauges update where a sync already
+        # happens (_report, monitor flush).
+        self._m_steps.inc()
+        self._m_loss_scale.set(self.loss_scaler.loss_scale)
+        self._m_lr.set(lr)
+        self._m_heartbeat.set(time.time())
+        if self._grad_sync_bytes:
+            self._m_grad_sync_bytes.inc(self._grad_sync_bytes)
+        now_pc = time.perf_counter()
+        if self._last_step_pc is not None and now_pc > self._last_step_pc and self._last_microbatch_tokens:
+            # dispatch rate, not device rate: honest once the pipeline is
+            # deep enough that dispatch tracks execution
+            self._m_tps.set(self._last_microbatch_tokens * self.gradient_accumulation_steps
+                            / (now_pc - self._last_step_pc))
+        self._last_step_pc = now_pc
         if self.global_steps % self.config.steps_per_print == 0:
             self._report(lr)
         if self.monitor is not None:
-            self.monitor.write_events([("Train/Samples/lr", lr, self.global_samples)])
+            # registry -> monitor bridge; the legacy Train/Samples/* series
+            # ride along verbatim (same host sync the old write_events paid)
+            extra = [("Train/Samples/lr", lr, self.global_samples)]
             if self._last_loss is not None:
-                self.monitor.write_events([("Train/Samples/train_loss", float(self._last_loss), self.global_samples)])
+                loss_host = float(self._last_loss)
+                self._m_loss.set(loss_host)
+                extra.append(("Train/Samples/train_loss", loss_host, self.global_samples))
+            self._monitor_bridge.maybe_flush(self.global_steps, extra_events=extra)
 
     def _start_flops_profile(self, batch, step, scale):
         """Reference ``engine.py:1800,1817``: flops profiler on a configured step.
@@ -706,6 +786,9 @@ class DeepSpeedEngine:
         # overflow counter here so static-scale overflow skips surface
         # without a per-step readback
         skipped = self.skipped_steps
+        self._m_loss.set(loss)
+        if self._global_grad_norm is not None:
+            self._m_gnorm.set(float(self._global_grad_norm))
         skip_note = f" skipped={skipped}" if skipped else ""
         log_dist(
             f"step={self.global_steps} loss={loss:.4f} lr={lr:.3e} "

@@ -1,0 +1,24 @@
+"""Unified telemetry: metrics registry, span tracer, monitor bridge.
+
+See docs/OBSERVABILITY.md for the metric catalog, span naming
+convention, and overhead guarantees. Env knobs: ``DS_TPU_TELEMETRY=0``
+disables both registry and tracer at startup; ``set_enabled()`` flips
+them at runtime.
+"""
+
+from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
+                       MetricsRegistry, get_registry)
+from .tracing import SpanTracer, dump_trace, get_tracer, span
+from .bridge import MonitorBridge
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
+    "get_registry", "SpanTracer", "get_tracer", "span", "dump_trace",
+    "MonitorBridge", "set_enabled",
+]
+
+
+def set_enabled(flag: bool) -> None:
+    """Enable/disable metric recording and span tracing process-wide."""
+    get_registry().enabled = bool(flag)
+    get_tracer().enabled = bool(flag)

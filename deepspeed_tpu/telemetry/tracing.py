@@ -1,0 +1,189 @@
+"""Wall-time span tracer with a fixed-size ring buffer.
+
+``span("train/forward", **attrs)`` records a span at *dispatch*
+granularity: entry/exit stamp ``time.perf_counter()`` and never touch a
+device, so a span around jitted work measures how long the Python side
+took to *enqueue* it — exactly the trace-safe semantics the async TPU
+dispatch model wants. ``blocking=True`` opts into a
+``block_until_ready`` on exit for honest end-to-end timings outside
+``jit`` (costs a device sync; never the default).
+
+Spans optionally mirror into XLA profiles through the accelerator's
+``range_push``/``range_pop`` hook (``jax.profiler.TraceAnnotation``),
+gated by ``DS_TPU_TRACE_XLA=1`` so profile-free runs pay nothing.
+
+``dump_trace(path)`` exports the ring as Chrome trace-event JSON
+(load in Perfetto / ``chrome://tracing``) or, for ``*.jsonl`` paths,
+one span per line.
+"""
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+_TLS = threading.local()
+
+
+class _NullSpan:
+    """Singleton no-op context manager — the disabled path allocates nothing."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _block_devices():
+    try:
+        import jax.numpy as jnp
+        (jnp.zeros(()) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class _ActiveSpan:
+    __slots__ = ("_tracer", "name", "attrs", "blocking", "t0", "depth")
+
+    def __init__(self, tracer, name, blocking, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.blocking = blocking
+        self.attrs = attrs
+
+    def __enter__(self):
+        tr = self._tracer
+        if tr.annotate_xla:
+            tr._range_push(self.name)
+        depth = getattr(_TLS, "depth", 0)
+        self.depth = depth
+        _TLS.depth = depth + 1
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        if self.blocking:
+            _block_devices()
+        t1 = time.perf_counter()
+        _TLS.depth = self.depth
+        tr = self._tracer
+        tr._ring.append((self.name, self.t0, t1 - self.t0,
+                         threading.get_ident(), self.depth, self.attrs))
+        if tr.annotate_xla:
+            tr._range_pop()
+        return False
+
+
+class SpanTracer:
+    """Ring-buffered span recorder. One process-wide instance via
+    ``get_tracer()``; direct construction is for tests."""
+
+    def __init__(self, capacity: int = 4096, enabled: bool = True,
+                 annotate_xla: bool = False):
+        self.enabled = enabled
+        self.annotate_xla = annotate_xla
+        self._ring = deque(maxlen=max(1, int(capacity)))
+        self._acc = None
+
+    def span(self, name: str, blocking: bool = False, **attrs):
+        if not self.enabled:
+            return _NULL_SPAN
+        return _ActiveSpan(self, name, blocking, attrs or None)
+
+    # ------------------------------------------------------- XLA mirror
+    def _range_push(self, name: str) -> None:
+        acc = self._acc
+        if acc is None:
+            try:
+                from ..accelerator import get_accelerator
+                acc = self._acc = get_accelerator()
+            except Exception:
+                self.annotate_xla = False
+                return
+        try:
+            acc.range_push(name)
+        except Exception:
+            self.annotate_xla = False
+
+    def _range_pop(self) -> None:
+        acc = self._acc
+        if acc is not None:
+            try:
+                acc.range_pop()
+            except Exception:
+                pass
+
+    # ---------------------------------------------------------- reading
+    def spans(self):
+        """Completed spans, oldest first, as dicts."""
+        return [
+            {"name": name, "start_s": t0, "dur_s": dur, "tid": tid,
+             "depth": depth, "attrs": attrs or {}}
+            for (name, t0, dur, tid, depth, attrs) in self._ring
+        ]
+
+    def clear(self) -> None:
+        self._ring.clear()
+
+    def dump_trace(self, path) -> str:
+        """Write the ring to ``path``: Chrome trace-event JSON by default,
+        one-record-per-line JSONL when the path ends in ``.jsonl``."""
+        path = str(path)
+        records = list(self._ring)
+        if path.endswith(".jsonl"):
+            with open(path, "w") as f:
+                for (name, t0, dur, tid, depth, attrs) in records:
+                    f.write(json.dumps({
+                        "name": name, "start_s": t0, "dur_s": dur,
+                        "tid": tid, "depth": depth, "attrs": attrs or {},
+                    }) + "\n")
+            return path
+        pid = os.getpid()
+        events = [
+            {"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dur * 1e6,
+             "pid": pid, "tid": tid,
+             "cat": name.split("/", 1)[0] if "/" in name else "span",
+             "args": attrs or {}}
+            for (name, t0, dur, tid, depth, attrs) in records
+        ]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+        return path
+
+
+_TRACER: Optional[SpanTracer] = None
+
+
+def get_tracer() -> SpanTracer:
+    """The process-wide tracer. Env knobs: ``DS_TPU_TELEMETRY=0`` disables,
+    ``DS_TPU_TRACE_RING`` sizes the ring, ``DS_TPU_TRACE_XLA=1`` mirrors
+    spans into XLA profiles."""
+    global _TRACER
+    if _TRACER is None:
+        _TRACER = SpanTracer(
+            capacity=int(os.environ.get("DS_TPU_TRACE_RING", "4096")),
+            enabled=os.environ.get("DS_TPU_TELEMETRY", "1") != "0",
+            annotate_xla=os.environ.get("DS_TPU_TRACE_XLA", "0") == "1",
+        )
+    return _TRACER
+
+
+def span(name: str, blocking: bool = False, **attrs):
+    """Module-level convenience over ``get_tracer().span(...)``."""
+    tracer = _TRACER
+    if tracer is None:
+        tracer = get_tracer()
+    if not tracer.enabled:
+        return _NULL_SPAN
+    return _ActiveSpan(tracer, name, blocking, attrs or None)
+
+
+def dump_trace(path) -> str:
+    return get_tracer().dump_trace(path)

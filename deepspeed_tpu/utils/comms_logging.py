@@ -9,7 +9,13 @@ sizes, latencies and achieved algorithmic/bus bandwidth, with a
 import math
 from typing import Dict
 
+from ..telemetry.registry import get_registry
 from .logging import logger
+
+# latency buckets (seconds) sized for collectives: sub-ms ICI hops up to
+# multi-second cross-pod gathers
+_COMM_LATENCY_BUCKETS = (0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005,
+                         0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
 
 
 def get_caller_func(frame: int = 3) -> str:
@@ -83,6 +89,14 @@ class CommsLogger:
 
     def append(self, raw_name: str, record_name: str, latency_s: float, msg_size: int, world_size: int):
         algbw, busbw = calc_bw_log(raw_name, msg_size, latency_s, world_size)
+        reg = get_registry()
+        if reg.enabled:
+            # this is the profiled (already-synced) path, so registry
+            # lookups per append are fine
+            reg.histogram("comm_latency_seconds", buckets=_COMM_LATENCY_BUCKETS,
+                          op=raw_name).observe(latency_s)
+            reg.gauge("comm_algbw_gbps", op=raw_name).set(algbw)
+            reg.gauge("comm_busbw_gbps", op=raw_name).set(busbw)
         per_op = self.comms_dict.setdefault(record_name, {})
         rec = per_op.setdefault(msg_size, [0, [], [], []])
         rec[0] += 1

@@ -9,6 +9,8 @@ import os
 
 MIN_COMPILE_TIME_SECS = 1.0
 
+_METRICS_REGISTERED = []
+
 
 def enable_compilation_cache(jax, default_dir: str, env_gate: str = "DS_BENCH_NO_CACHE",
                              env_dir: str = "JAX_COMPILATION_CACHE_DIR"):
@@ -20,3 +22,38 @@ def enable_compilation_cache(jax, default_dir: str, env_gate: str = "DS_BENCH_NO
         return
     jax.config.update("jax_compilation_cache_dir", os.environ.get(env_dir, default_dir))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", MIN_COMPILE_TIME_SECS)
+    register_cache_metrics(jax)
+
+
+def register_cache_metrics(jax) -> bool:
+    """Feed jax's compilation-cache monitoring events into the telemetry
+    registry (``compile_cache_hits_total`` / ``compile_cache_misses_total``).
+
+    Idempotent; returns True once a listener is installed. Tolerant of
+    jax versions without the monitoring API or with renamed event keys —
+    any substring match on compilation_cache hit/miss counts.
+    """
+    if _METRICS_REGISTERED:
+        return True
+    try:
+        from jax import monitoring
+
+        from ..telemetry.registry import get_registry
+
+        reg = get_registry()
+        hits = reg.counter("compile_cache_hits_total")
+        misses = reg.counter("compile_cache_misses_total")
+
+        def _listener(event, *args, **kwargs):
+            if "compilation_cache" not in event:
+                return
+            if "hit" in event:
+                hits.inc()
+            elif "miss" in event:
+                misses.inc()
+
+        monitoring.register_event_listener(_listener)
+        _METRICS_REGISTERED.append(_listener)
+        return True
+    except Exception:
+        return False

@@ -5,20 +5,39 @@ indefinitely and cannot be cancelled; everything that probes the backend
 (``bench.py``, ``env_report``) shares this one spawn/join/timeout
 protocol so the tunnel-handling behavior cannot drift between
 diagnostics.
+
+Telemetry: every timeout increments ``watchdog_timeouts_total``; paired
+with the engine's ``last_step_completed_unix`` heartbeat gauge this
+makes a wedged tunnel distinguishable from a merely slow step.
 """
 
+import os
 import threading
-from typing import Any, Callable, Tuple
+from typing import Any, Callable, Optional, Tuple
+
+DEFAULT_TIMEOUT_S = 180.0
 
 
-def run_with_watchdog(fn: Callable[[], Any], timeout_s: float) -> Tuple[str, Any]:
-    """Run ``fn()`` on a daemon thread with a deadline.
+def default_timeout() -> float:
+    """The watchdog deadline when callers pass none: 180 s, overridable
+    via ``DS_TPU_WATCHDOG_TIMEOUT_S``."""
+    try:
+        return float(os.environ.get("DS_TPU_WATCHDOG_TIMEOUT_S", DEFAULT_TIMEOUT_S))
+    except ValueError:
+        return DEFAULT_TIMEOUT_S
+
+
+def run_with_watchdog(fn: Callable[[], Any], timeout_s: Optional[float] = None) -> Tuple[str, Any]:
+    """Run ``fn()`` on a daemon thread with a deadline (``default_timeout()``
+    when ``timeout_s`` is None).
 
     Returns ``("ok", result)``, ``("error", exception)``, or
     ``("timeout", None)``. On timeout the thread is still stuck inside
     ``fn`` (likely holding the backend-init lock), so the caller must not
     make further backend calls in this process.
     """
+    if timeout_s is None:
+        timeout_s = default_timeout()
     box: dict = {}
 
     def run():
@@ -34,4 +53,7 @@ def run_with_watchdog(fn: Callable[[], Any], timeout_s: float) -> Tuple[str, Any
         return "error", box["error"]
     if "value" in box:
         return "ok", box["value"]
+    from ..telemetry.registry import get_registry
+
+    get_registry().counter("watchdog_timeouts_total").inc()
     return "timeout", None

@@ -56,3 +56,75 @@ def test_freeze_table_roundtrip():
     the rows BASELINE.md carries."""
     rows = bench.freeze_table().splitlines()
     assert rows == [f"| `{r}` | `{bench._contract_hash(r)}` |" for r in bench.RUNG_CONTRACTS]
+
+
+def test_disabled_telemetry_overhead_within_five_percent():
+    """docs/OBSERVABILITY.md overhead guarantee: a hot loop with disabled
+    telemetry stays within 5% of the same loop with no telemetry at all.
+    min-of-5 reps + a small absolute epsilon keep CI scheduling noise out."""
+    import time
+
+    from deepspeed_tpu.telemetry import MetricsRegistry, SpanTracer
+
+    reg = MetricsRegistry(enabled=False)
+    tracer = SpanTracer(enabled=False)
+    c = reg.counter("bench_overhead_total")
+    h = reg.histogram("bench_overhead_seconds")
+    n = 1000
+
+    def base_loop():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            sum(range(2000))
+        return time.perf_counter() - t0
+
+    def tele_loop():
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with tracer.span("bench/work"):
+                c.inc()
+                h.observe(0.001)
+                sum(range(2000))
+        return time.perf_counter() - t0
+
+    base_loop(), tele_loop()  # warm
+    base = min(base_loop() for _ in range(5))
+    tele = min(tele_loop() for _ in range(5))
+    assert tele <= base * 1.05 + 5e-4, f"disabled-telemetry loop {tele:.4f}s vs bare {base:.4f}s"
+    assert reg.peek("bench_overhead_total") == 0  # truly off, not just fast
+
+
+def test_render_prometheus_parses_clean():
+    """Every emitted series must use a legal Prometheus name and appear at
+    most once — the properties a scraper actually depends on."""
+    import re
+
+    from deepspeed_tpu.telemetry import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.counter("train_steps_total").inc(3)
+    reg.counter("comm_bytes_total", op="all_reduce").inc(1 << 20)
+    reg.counter("comm_bytes_total", op="all_gather").inc(7)
+    reg.gauge("kv_block_occupancy").set(0.5)
+    reg.histogram("infer_ttft_seconds", buckets=(0.1, 1.0)).observe(0.2)
+
+    name_re = re.compile(r"^[a-z_][a-z0-9_]*$")
+    seen = set()
+    types = {}
+    for line in reg.render_prometheus().splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ")
+            assert name_re.match(name), line
+            assert name not in types, f"duplicate TYPE line: {line}"
+            types[name] = kind
+            continue
+        series, value = line.rsplit(" ", 1)
+        float(value)  # every sample value parses
+        name = series.split("{", 1)[0]
+        bare = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name_re.match(name), line
+        assert name in types or bare in types, f"sample without TYPE family: {line}"
+        assert series not in seen, f"duplicate series: {line}"
+        seen.add(series)
+    assert types == {"train_steps_total": "counter", "comm_bytes_total": "counter",
+                     "kv_block_occupancy": "gauge", "infer_ttft_seconds": "histogram"}
