@@ -182,6 +182,12 @@ def run_config(deepspeed_tpu, jax, np, cfg_model, micro_bs, seq, iters, stage=2)
     return global_bs * seq * iters / dt, float(loss)
 
 
+def _quant_bits() -> int:
+    """DS_BENCH_QUANT: "1"/"8" -> int8 A/B, "4" -> int4 A/B, else dense."""
+    v = os.environ.get("DS_BENCH_QUANT", "")
+    return {"1": 8, "8": 8, "4": 4}.get(v, 0)
+
+
 def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
     """Greedy decode throughput (new tokens/s), prefill excluded.
 
@@ -195,8 +201,9 @@ def run_decode(jax, jnp, np, cfg_model, batch, prompt_len, new_tokens):
     model = CausalLM(cfg_model)
     params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, prompt_len), np.int32)})
     v1_cfg = {"dtype": "bf16", "max_out_tokens": prompt_len + new_tokens}
-    if os.environ.get("DS_BENCH_QUANT") == "1":  # int8 weight-only A/B
-        v1_cfg["quant"] = {"enabled": True, "bits": 8, "group_size": 128}
+    qb = _quant_bits()
+    if qb:  # int8/int4 weight-only A/B
+        v1_cfg["quant"] = {"enabled": True, "bits": qb, "group_size": 128}
     eng = deepspeed_tpu.init_inference(model, config=v1_cfg, params=params)
     rng = np.random.RandomState(0)
     prompts = rng.randint(0, cfg_model.vocab_size, size=(batch, prompt_len)).astype(np.int32)
@@ -256,8 +263,12 @@ def run_serve_sla(jax, jnp, np, cfg_model, platform):
                            arrival_rate=1e9))
     rows = sweep(eng, rates=rates, base=base)
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_SLA.json")
+    table = {"platform": platform, "rows": rows}
+    if platform != "tpu":
+        table["note"] = ("CPU-platform table: shapes/latencies are the CPU smoke workload only and "
+                         "say nothing about TPU serving. UNMEASURED ON TPU.")
     with open(path, "w") as f:
-        json.dump({"platform": platform, "rows": rows}, f, indent=1)
+        json.dump(table, f, indent=1)
     return effective_throughput_at_sla(rows), rows
 
 
@@ -281,19 +292,27 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
     # zero-fill pages the CPU smoke path never touches)
     smc = RaggedBatchConfig(max_context=max_ctx)
     smc.num_kv_blocks = n_prompts * (-(-max_ctx // smc.kv_block_size)) + 8
-    cfg = RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16",
-                                      quant_bits=8 if os.environ.get("DS_BENCH_QUANT") == "1" else 0)
+    cfg = RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16", quant_bits=_quant_bits())
     eng = InferenceEngineV2(model, params, cfg)
     rng = np.random.RandomState(0)
     # varied prompt lengths: a ragged workload, not a lockstep batch
     lens = rng.randint(max(4, prompt_len // 2), prompt_len + 1, size=n_prompts)
     prompts = [rng.randint(0, cfg_model.vocab_size, size=(int(l),)).tolist() for l in lens]
     eng.generate(prompts, max_new_tokens=new_tokens)  # compile every bucket/burst shape
+    from deepspeed_tpu.telemetry import get_registry
+    disp = get_registry().counter("infer_dispatches_total")
+    d0 = disp.value
     t0 = time.perf_counter()
     out = eng.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0
     assert all(len(o) == new_tokens for o in out)
-    return n_prompts * new_tokens / dt
+    served = n_prompts * new_tokens
+    # dispatch accounting: the fused serving loop's headline is programs
+    # per served token (docs/SERVING.md); rides the result dict as extra
+    # keys — contracts and their frozen hashes are untouched
+    return served / dt, {"dispatches": int(disp.value - d0),
+                         "tokens_per_dispatch": round(served / max(1, disp.value - d0), 2),
+                         "fused": eng._fused_enabled}
 
 
 def _probe_backend(timeout_s: float = 180.0):
@@ -430,7 +449,8 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
         }
     if rung == "serve":
         serve_prompts, serve_new = (32, 128) if platform == "tpu" else (3, 8)
-        tps = run_serve(jax, jnp, np, cfg_model, serve_prompts, prompt_len=decode_bs * 4, new_tokens=serve_new)
+        tps, disp = run_serve(jax, jnp, np, cfg_model, serve_prompts, prompt_len=decode_bs * 4,
+                              new_tokens=serve_new)
         # same HBM-bound derivation as decode (module docstring); the serving
         # loop additionally carries prefill + scheduling overhead
         baseline = RUNG_CONTRACTS["serve"]["baseline_tokens_per_sec_chip"]
@@ -439,6 +459,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             "value": round(tps, 1),
             "unit": "tokens/s/chip",
             "vs_baseline": round(tps / baseline, 4),
+            **disp,
         }
     if rung == "serve_sla":
         eff, rows = run_serve_sla(jax, jnp, np, cfg_model, platform)

@@ -15,6 +15,7 @@ TPU-native version orchestrates ``trial_runner`` subprocesses:
   sequential (it needs feedback between proposals).
 """
 
+import base64
 import json
 import os
 import subprocess
@@ -52,7 +53,10 @@ class TrialScheduler:
         self.timeout_s = float(timeout_s)
         self.env = env
         self.remote_python = remote_python  # bare "python" is absent on python3-only hosts
-        self._b64_cache: Dict[str, str] = {}
+        # path -> ((mtime_ns, size), b64): keyed on file identity, so a
+        # capture npz rewritten between trials (same path, new contents)
+        # re-encodes instead of shipping the stale payload
+        self._b64_cache: Dict[str, Tuple[Tuple[int, int], str]] = {}
 
     def run_one(self, spec: Dict, slot: int = 0) -> Optional[Dict]:
         """Launch the runner on the slot and parse its result:
@@ -97,19 +101,27 @@ class TrialScheduler:
             with open(out_path) as f:
                 return json.load(f)
 
-    def _run_piped(self, spec: Dict, prefix: List[str], env: Dict[str, str]) -> Optional[Dict]:
-        import base64
+    def _b64_for(self, npz: str) -> str:
+        st = os.stat(npz)
+        sig = (st.st_mtime_ns, st.st_size)
+        hit = self._b64_cache.get(npz)
+        if hit is None or hit[0] != sig:
+            with open(npz, "rb") as f:
+                self._b64_cache[npz] = (sig, base64.b64encode(f.read()).decode())
+        return self._b64_cache[npz][1]
 
+    def _run_piped(self, spec: Dict, prefix: List[str], env: Dict[str, str]) -> Optional[Dict]:
         from .trial_runner import RESULT_SENTINEL
 
         spec = dict(spec)
         npz = spec.pop("batches_npz", None)
         if npz and "batches_b64" not in spec:
-            if npz not in self._b64_cache:  # every spec shares one npz; encode once
-                with open(npz, "rb") as f:
-                    self._b64_cache[npz] = base64.b64encode(f.read()).decode()
-            spec["batches_b64"] = self._b64_cache[npz]
-        cmd = prefix + [self.remote_python, "-m", "deepspeed_tpu.autotuning.trial_runner", "-"]
+            spec["batches_b64"] = self._b64_for(npz)
+        # a no-prefix slot runs on THIS host: launch the interpreter
+        # actually running the scheduler, not a guessed "python3" from
+        # PATH (which may be a different venv, or absent)
+        interp = self.remote_python if prefix else sys.executable
+        cmd = prefix + [interp, "-m", "deepspeed_tpu.autotuning.trial_runner", "-"]
         try:
             proc = subprocess.run(cmd, input=json.dumps(spec).encode(), capture_output=True,
                                   timeout=self.timeout_s, env=env)

@@ -16,6 +16,7 @@ paged-KV plumbing. TPU re-design:
 """
 
 import dataclasses
+import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -27,9 +28,9 @@ from ...models.transformer import TransformerConfig
 from ...telemetry import get_registry as get_telemetry_registry
 from ...telemetry import span as telemetry_span
 from ...utils.logging import log_dist, logger
-from .model_runner import make_burst_fn, make_step_fns
+from .model_runner import make_burst_fn, make_fused_step_fn, make_step_fns
 from .ragged.manager import DSStateManager, RaggedBatchConfig
-from .scheduler import RaggedBatchScheduler, RaggedRequest
+from .scheduler import FusedQuantum, RaggedBatchScheduler, RaggedRequest
 
 
 def _next_pow2(n: int) -> int:
@@ -47,6 +48,9 @@ class RaggedInferenceEngineConfig:
     dtype: str = "bfloat16"
     interpret_kernels: Optional[bool] = None  # Pallas interpret mode; default: on unless running on real TPU
     decode_burst: int = 32  # max fused greedy-decode steps per dispatch (0 disables bursting)
+    fused_step: Optional[bool] = None  # ONE dispatched program per scheduler quantum (SplitFuse
+    # mixed prefill+decode). None: on unless DS_TPU_SERVE_FUSED=0; the unfused
+    # per-phase dispatch loop stays available as the fallback.
     min_decode_bucket: int = 8  # floor for the padded decode batch: fewer compiled
     # (B, steps) shapes (padded rows write to the garbage page, so a bigger
     # bucket costs nothing real); 1 restores exact power-of-two bucketing
@@ -137,6 +141,10 @@ class InferenceEngineV2:
         self._m_bursts = tele.counter("infer_decode_bursts_total")
         self._m_decode_fill = tele.gauge("infer_decode_batch_fill")
         self._m_prefill_fill = tele.gauge("infer_prefill_batch_fill")
+        # fused serving loop: dispatches/quantum invariant + fill factor
+        self._m_dispatches = tele.counter("infer_dispatches_total")
+        self._m_fused_quanta = tele.counter("infer_fused_quanta_total")
+        self._m_fused_fill = tele.gauge("infer_fused_batch_fill")
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -175,6 +183,11 @@ class InferenceEngineV2:
         self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp)
         self._run_cfg, self._interpret, self._run_mesh = run_cfg, interpret, run_mesh
         self._bursts: Dict[tuple, object] = {}  # sampling signature -> jitted burst
+        self._fused_fns: Dict[tuple, object] = {}  # (bucket shape, sampling) -> jitted fused step
+        fused = config.fused_step
+        if fused is None:
+            fused = os.environ.get("DS_TPU_SERVE_FUSED", "1") != "0"
+        self._fused_enabled = bool(fused)
         self._sampling = None  # (do_sample, temperature, top_k, top_p) during generate()
         self._rng = jax.random.PRNGKey(0)
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
@@ -308,9 +321,7 @@ class InferenceEngineV2:
 
     # ---------------------------------------------------------- internals
     def _seq_block_row(self, seq) -> np.ndarray:
-        row = np.full((self._max_blocks_per_seq,), self._garbage_block, np.int32)
-        row[:len(seq.blocks)] = seq.blocks
-        return row
+        return self.state.block_table_row(seq, self._max_blocks_per_seq, self._garbage_block)
 
     def _garbage_slots(self, n: int) -> np.ndarray:
         # round-robin within the garbage page so padded writes stay cheap
@@ -368,6 +379,7 @@ class InferenceEngineV2:
                                                                   self.k_pages, self.v_pages, jnp.asarray(bt),
                                                                   jnp.asarray(ctx), jnp.asarray(slots.reshape(-1)),
                                                                   jnp.asarray(last))
+        self._m_dispatches.inc()
         self._m_prefill_tokens.inc(sum(len(t) for t in token_lists))
         self._m_prefill_fill.set(n / B)
         for seq in seqs:
@@ -432,6 +444,7 @@ class InferenceEngineV2:
                                                                  self.k_pages, self.v_pages, jnp.asarray(bt),
                                                                  jnp.asarray(ctx), jnp.asarray(slots[0]),
                                                                  jnp.asarray(last))
+        self._m_dispatches.inc()
         self._m_decode_steps.inc()
         self._m_decode_tokens.inc(n)
         self._m_decode_fill.set(n / len(ctx))
@@ -474,6 +487,7 @@ class InferenceEngineV2:
             toks, self.k_pages, self.v_pages = self._burst_for(self._sampling)(
                 self.params, ids_in, jnp.asarray(positions), self.k_pages, self.v_pages,
                 jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(last), burst_rng)
+        self._m_dispatches.inc()
         self._m_bursts.inc()
         self._m_decode_steps.inc(steps)
         self._m_decode_tokens.inc(n * steps)
@@ -483,6 +497,174 @@ class InferenceEngineV2:
         if defer:
             return toks[:n]  # device (n, steps), no readback
         return np.asarray(toks[:n])
+
+    # ---------------------------------------------------------- fused quantum
+    def _fused_bucket(self, n_dec: int, n_pre: int, max_chunk: int) -> Tuple[int, int, int]:
+        """Padded (decode rows, prefill rows, chunk) bucket for a quantum —
+        the (total_tokens_pow2, n_seqs_pow2) ladder that keeps the fused
+        program cache logarithmic: the decode segment rides the existing
+        decode bucket floor, prefill rows pad to a power of two, and the
+        chunk length pads like the unfused prefill buckets (single-token
+        tail chunks keep chunk == 1: they are decode-shaped and unify
+        into one kernel launch)."""
+        D = self._decode_bucket(n_dec) if n_dec else 0
+        P = _next_pow2(n_pre) if n_pre else 0
+        if n_pre == 0:
+            S = 0
+        elif max_chunk == 1:
+            S = 1
+        else:
+            S = max(16, _next_pow2(max_chunk))
+        return D, P, S
+
+    _MAX_FUSED_VARIANTS = 8
+
+    def _fused_for(self, n_dec: int, n_pre: int, chunk: int, sampling):
+        """LRU-bounded cache of fused-step programs keyed on the padded
+        bucket shape + sampling signature — the fused sibling of
+        ``_burst_for`` (same eviction discipline: each value owns its jit
+        wrapper, so eviction frees the compiled executables). The burst
+        step count is NOT part of the key: it rides the follow-on slot
+        table's leading dim, so one wrapper serves the whole ladder."""
+        key = (n_dec, n_pre, chunk) + (sampling or (False, 1.0, 0, 1.0))
+        if key not in self._fused_fns:
+            if len(self._fused_fns) >= self._MAX_FUSED_VARIANTS:
+                self._fused_fns.pop(next(iter(self._fused_fns)))
+            do, t, k, p = key[3:]
+            self._fused_fns[key] = make_fused_step_fn(self._run_cfg, interpret=self._interpret,
+                                                      mesh=self._run_mesh, tp=self._tp,
+                                                      n_dec=n_dec, n_pre=n_pre, chunk=chunk,
+                                                      do_sample=do, temperature=t, top_k=k, top_p=p)
+        else:
+            self._fused_fns[key] = self._fused_fns.pop(key)  # LRU touch
+        return self._fused_fns[key]
+
+    def _run_fused(self, quantum: FusedQuantum, decode_carry: List, steps: int, defer: bool,
+                   eos_token_id: Optional[int]) -> Dict[int, object]:
+        """ONE dispatch for a whole scheduler quantum: decode rows and
+        chunked-prefill rows run as a single flat ragged batch, then the
+        batch advances ``steps - 1`` more decode steps in-graph (pure-
+        decode quanta only — mixed quanta run with steps == 1 so the next
+        admission wave isn't starved).
+
+        Returns uid -> (steps,) token row (device array when ``defer``,
+        np otherwise), or None for a mid-prompt prefill chunk (its logits
+        are not a sampled token yet).
+        """
+        dec_uids = quantum.decode_uids
+        prefills = quantum.prefills
+        n_dec, n_pre = len(dec_uids), len(prefills)
+        assert steps == 1 or n_pre == 0, "multi-step bursts are pure-decode"
+        max_chunk = max((len(p.tokens) for p in prefills), default=0)
+        D, P, S = self._fused_bucket(n_dec, n_pre, max_chunk)
+        T = D + P * S
+        N = D + P
+        bs = self.state.block_size
+
+        # validate the WHOLE quantum before mutating any state (same
+        # discipline as _run_prefill_batch: a mid-loop allocation failure
+        # must not strand in-flight tokens or leak descriptor slots)
+        total_need = 0
+        for uid in dec_uids:
+            seq = self.state.get_sequence(uid)
+            if seq.seen_tokens + seq.in_flight_tokens + steps > self.state.max_context:
+                raise RuntimeError(f"sequence {uid}: {seq.seen_tokens + steps} tokens exceeds "
+                                   f"max_context {self.state.max_context}")
+            total_need += seq.blocks_needed(steps)
+        for pf in prefills:
+            seq = self.state.get_sequence(pf.uid)
+            seen = (seq.seen_tokens + seq.in_flight_tokens) if seq is not None else 0
+            if seen + len(pf.tokens) > self.state.max_context:
+                raise RuntimeError(f"sequence {pf.uid}: {seen + len(pf.tokens)} tokens exceeds "
+                                   f"max_context {self.state.max_context}")
+            total_need += seq.blocks_needed(len(pf.tokens)) if seq is not None else -(-len(pf.tokens) // bs)
+        if not self.state.can_allocate(total_need):
+            raise RuntimeError(f"fused quantum needs {total_need} KV blocks, "
+                               f"{self.state.free_blocks} free")
+
+        ids = np.zeros((T,), np.int32)
+        positions = np.zeros((T,), np.int32)
+        slots0 = self._garbage_slots(T)
+        ctx = np.ones((N,), np.int32)
+        bt = np.full((N, self._max_blocks_per_seq), self._garbage_block, np.int32)
+        last = np.zeros((N,), np.int32)
+        gslots = self._garbage_slots(N)
+        adv = np.tile(gslots[None], (steps - 1, 1))
+        step_idx = np.arange(1, steps)
+        seqs = []
+
+        for j, uid in enumerate(dec_uids):
+            seq = self.state.get_sequence(uid)
+            self.state.allocate_for(seq, steps)
+            seq.pre_forward(steps)
+            pos0 = seq.seen_tokens
+            blocks = np.asarray(seq.blocks, np.int32)
+            if not defer:
+                ids[j] = int(decode_carry[j])
+            positions[j] = pos0
+            ctx[j] = pos0 + 1
+            bt[j] = self._seq_block_row(seq)
+            last[j] = j
+            slots0[j] = blocks[pos0 // bs] * bs + pos0 % bs
+            if steps > 1:
+                p = pos0 + step_idx
+                adv[:, j] = blocks[p // bs] * bs + p % bs
+            seqs.append(seq)
+
+        for r, pf in enumerate(prefills):
+            seq = self.state.get_or_create_sequence(pf.uid)
+            m = len(pf.tokens)
+            self.state.allocate_for(seq, m)
+            seq.pre_forward(m)
+            start = seq.seen_tokens
+            blocks = np.asarray(seq.blocks, np.int32)
+            base, row = D + r * S, D + r
+            ids[base:base + m] = pf.tokens
+            pos = start + np.arange(m)
+            positions[base:base + m] = pos
+            slots0[base:base + m] = blocks[pos // bs] * bs + pos % bs
+            ctx[row] = start + m
+            bt[row] = self._seq_block_row(seq)
+            last[row] = base + m - 1
+            seqs.append(seq)
+
+        ids_dev = jnp.asarray(ids)
+        if n_dec and defer:
+            # device token scalars from the previous quantum stack into the
+            # decode segment without a host sync
+            col = jnp.stack([jnp.asarray(t, jnp.int32).reshape(()) for t in decode_carry])
+            ids_dev = ids_dev.at[:n_dec].set(col)
+
+        fn = self._fused_for(D, P, S, self._sampling)
+        self._rng, rng = jax.random.split(self._rng)
+        eos = jnp.int32(-1 if eos_token_id is None else int(eos_token_id))
+        with telemetry_span("infer/fused_step", rows=N, tokens=T, steps=steps):
+            toks, self.k_pages, self.v_pages = fn(self.params, ids_dev, jnp.asarray(positions),
+                                                  self.k_pages, self.v_pages, jnp.asarray(bt),
+                                                  jnp.asarray(ctx), jnp.asarray(slots0),
+                                                  jnp.asarray(last), jnp.asarray(adv),
+                                                  jnp.asarray(gslots), eos, rng)
+        self._m_dispatches.inc()
+        self._m_fused_quanta.inc()
+        real = n_dec * steps + sum(len(p.tokens) for p in prefills)
+        self._m_fused_fill.set(real / max(1, D * steps + P * S))
+        if n_dec:
+            self._m_decode_steps.inc(steps)
+            self._m_decode_tokens.inc(n_dec * steps)
+        if prefills:
+            self._m_prefill_tokens.inc(sum(len(p.tokens) for p in prefills))
+        for seq in seqs:
+            seq.post_forward()
+
+        out: Dict[int, object] = {}
+        for j, uid in enumerate(dec_uids):
+            out[uid] = toks[j] if defer else np.asarray(toks[j])
+        for r, pf in enumerate(prefills):
+            if pf.final:
+                out[pf.uid] = toks[D + r] if defer else np.asarray(toks[D + r])
+            else:
+                out[pf.uid] = None
+        return out
 
     # ---------------------------------------------------------- serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
@@ -514,23 +696,8 @@ class InferenceEngineV2:
         finally:
             self._sampling = None
 
-    def _generate(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
-        # Deferred mode: when nothing on the host needs token VALUES
-        # mid-stream (no EOS cut, no streaming callback), the scheduler's
-        # decisions depend only on counts and block accounting — so the
-        # inter-dispatch token carry stays ON DEVICE (decode_ready maps
-        # uid -> 0-d device array) and the only host sync in the whole
-        # generate is the final fetch. Over a tunneled chip each avoided
-        # readback is a ~100 ms roundtrip; the first on-chip serve capture
-        # (round 5) measured the synchronous loop 20x below the decode
-        # ceiling for exactly this reason.
-        deferred = eos_token_id is None and on_token is None
-        reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
-        pending = list(reqs.values())
-        decode_ready: Dict[int, object] = {}  # uid -> next token to feed (int, or device scalar when deferred)
-        results: Dict[int, List[int]] = {i: [] for i in reqs}
-        pieces: Dict[int, List[object]] = {i: [] for i in reqs}  # deferred: device arrays
-        counts: Dict[int, int] = {i: 0 for i in reqs}
+    def _commit_closures(self, reqs, results, pieces, counts, decode_ready, eos_token_id, on_token):
+        """(commit, commit_dev) shared by the fused and unfused loops."""
 
         def commit(uid: int, toks_out: List[int]) -> None:
             """Record sampled tokens and retire/continue the request."""
@@ -561,6 +728,93 @@ class InferenceEngineV2:
                 self.flush([uid])
             else:
                 decode_ready[uid] = row[-1]
+
+        return commit, commit_dev
+
+    @staticmethod
+    def _collect_results(prompts, deferred, results, pieces) -> List[List[int]]:
+        if not deferred:
+            return [results[i] for i in range(len(prompts))]
+        # one fetch for everything: equal lengths (no EOS) stack into a
+        # single (n_prompts, max_new_tokens) transfer
+        rows = [jnp.concatenate(pieces[i]) if len(pieces[i]) > 1 else pieces[i][0] for i in range(len(prompts))]
+        lens = {int(r.shape[0]) for r in rows}
+        if len(lens) == 1:
+            arr = np.asarray(jnp.stack(rows))
+            return [arr[i].tolist() for i in range(len(prompts))]
+        return [np.asarray(r).tolist() for r in rows]
+
+    def _generate(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
+        if self._fused_enabled:
+            return self._generate_fused(prompts, max_new_tokens, eos_token_id, on_token)
+        return self._generate_unfused(prompts, max_new_tokens, eos_token_id, on_token)
+
+    def _generate_fused(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
+        """The SplitFuse hot path: the host only admits/evicts, allocates
+        blocks, and commits streams — every scheduler quantum (mixed
+        chunked-prefill + decode rows) is ONE dispatched program, and
+        pure-decode quanta between admission waves extend to multi-step
+        fused bursts inside the same program (lax.scan tail). Unlike the
+        unfused burst path, bursts stay on even with an EOS cut or a
+        streaming callback: finished rows are masked in-graph and the
+        host truncates at commit."""
+        deferred = eos_token_id is None and on_token is None
+        reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
+        pending = list(reqs.values())
+        decode_ready: Dict[int, object] = {}  # uid -> next token to feed (int, or device scalar when deferred)
+        results: Dict[int, List[int]] = {i: [] for i in reqs}
+        pieces: Dict[int, List[object]] = {i: [] for i in reqs}  # deferred: device arrays
+        counts: Dict[int, int] = {i: 0 for i in reqs}
+        commit, commit_dev = self._commit_closures(reqs, results, pieces, counts, decode_ready,
+                                                   eos_token_id, on_token)
+
+        while pending or decode_ready:
+            quantum = self.scheduler.schedule_fused([r for r in pending if r.remaining_prefill],
+                                                    list(decode_ready))
+            if quantum.empty:
+                raise RuntimeError("scheduler deadlock: no work schedulable (KV pool too small?)")
+            for pf in quantum.prefills:
+                reqs[pf.uid].tokens = reqs[pf.uid].tokens[len(pf.tokens):]
+            steps = 1
+            if quantum.decode_uids and not quantum.prefills and not pending:
+                # between admission waves: everyone is decoding — extend the
+                # quantum to a fused multi-step burst (pow2 ladder, bounded
+                # by budgets / max_context / free blocks like _burst_steps)
+                done_count = counts if deferred else {u: len(results[u]) for u in quantum.decode_uids}
+                rem = min(reqs[u].max_new_tokens - done_count[u] for u in quantum.decode_uids)
+                steps = max(1, self._burst_steps({u: True for u in quantum.decode_uids}, rem))
+            carry = [decode_ready.pop(u) for u in quantum.decode_uids]
+            rows = self._run_fused(quantum, carry, steps, deferred, eos_token_id)
+            for uid, row in rows.items():
+                if row is None:
+                    continue  # mid-prompt prefill chunk: no sampled token yet
+                if deferred:
+                    commit_dev(uid, row)
+                else:
+                    commit(uid, row.tolist())
+            pending = [r for r in pending if not r.done and r.remaining_prefill]
+
+        return self._collect_results(prompts, deferred, results, pieces)
+
+    def _generate_unfused(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
+        # Deferred mode: when nothing on the host needs token VALUES
+        # mid-stream (no EOS cut, no streaming callback), the scheduler's
+        # decisions depend only on counts and block accounting — so the
+        # inter-dispatch token carry stays ON DEVICE (decode_ready maps
+        # uid -> 0-d device array) and the only host sync in the whole
+        # generate is the final fetch. Over a tunneled chip each avoided
+        # readback is a ~100 ms roundtrip; the first on-chip serve capture
+        # (round 5) measured the synchronous loop 20x below the decode
+        # ceiling for exactly this reason.
+        deferred = eos_token_id is None and on_token is None
+        reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
+        pending = list(reqs.values())
+        decode_ready: Dict[int, object] = {}  # uid -> next token to feed (int, or device scalar when deferred)
+        results: Dict[int, List[int]] = {i: [] for i in reqs}
+        pieces: Dict[int, List[object]] = {i: [] for i in reqs}  # deferred: device arrays
+        counts: Dict[int, int] = {i: 0 for i in reqs}
+        commit, commit_dev = self._commit_closures(reqs, results, pieces, counts, decode_ready,
+                                                   eos_token_id, on_token)
 
         while pending or decode_ready:
             # Burst path: nothing left to admit and everyone is decoding —
@@ -610,13 +864,4 @@ class InferenceEngineV2:
                     commit(uid, [int(tok)])
             pending = [r for r in pending if not r.done and r.remaining_prefill]
 
-        if not deferred:
-            return [results[i] for i in range(len(prompts))]
-        # one fetch for everything: equal lengths (no EOS) stack into a
-        # single (n_prompts, max_new_tokens) transfer
-        rows = [jnp.concatenate(pieces[i]) if len(pieces[i]) > 1 else pieces[i][0] for i in range(len(prompts))]
-        lens = {int(r.shape[0]) for r in rows}
-        if len(lens) == 1:
-            arr = np.asarray(jnp.stack(rows))
-            return [arr[i].tolist() for i in range(len(prompts))]
-        return [np.asarray(r).tolist() for r in rows]
+        return self._collect_results(prompts, deferred, results, pieces)

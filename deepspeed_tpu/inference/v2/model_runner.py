@@ -29,11 +29,20 @@ from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+
+try:  # jax >= 0.6 exposes shard_map at the top level (check_vma keyword)
+    from jax import shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+    MODERN_SHARD_MAP = True
+except ImportError:  # pragma: no cover — older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map
+    _SHARD_MAP_KW = {"check_rep": False}
+    MODERN_SHARD_MAP = False
 from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import (TransformerConfig, alibi_slopes, apply_rope, scaled_rope_frequencies)
-from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_prefill, update_kv_pages)
+from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_mixed,
+                                           paged_attention_prefill, update_kv_pages)
 from ...ops.registry import REGISTRY
 from .modules import _norm_p, _proj, build_modules
 
@@ -41,6 +50,90 @@ from .modules import _norm_p, _proj, build_modules
 def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
     freq = max(1, cfg.moe_layer_freq)
     return cfg.moe_num_experts > 0 and (i % freq == freq - 1)
+
+
+def _attn_fn_builder(cfg: TransformerConfig, interpret: bool, mesh, tp: int):
+    """window -> (decode_attn, prefill_attn, native) — shared by the ragged
+    and fused forwards so both hot paths bake identical kernel variants."""
+    H = cfg.n_heads
+    if mesh is not None and tp > 1:
+        # heads split over `tensor`: each shard decodes its own heads
+        # against its KV-page shard (ref v2 sharding helpers). Per-shard
+        # slope slices aren't expressible as a baked constant, so ALiBi/
+        # window models route through the gather path under TP.
+        tp_decode_attn = shard_map(
+            functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale),
+            mesh=mesh, in_specs=(P(None, "tensor", None), P(None, None, "tensor", None),
+                                 P(None, None, "tensor", None), P(None, None), P(None)),
+            out_specs=P(None, "tensor", None), **_SHARD_MAP_KW)
+        return lambda window: (tp_decode_attn, None, False)
+    # one (decode, prefill) pair per distinct per-layer window value
+    # (gpt-neo alternates global/local; qwen2 windows a layer suffix) —
+    # the layer loop is unrolled, so windows are static per layer and
+    # each value bakes its own kernel variant
+    _slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
+    _fns = {}
+
+    def attn_fns(window):
+        if window not in _fns:
+            decode = functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale,
+                                       alibi_slopes=_slopes, window=window)
+            # interpret mode (CPU dev serving) keeps the compute-bound
+            # prefill on the fused XLA gather path — emulating the
+            # page-walk kernel there is strictly slower; on real TPU the
+            # kernel avoids the context gather
+            prefill = None if interpret else functools.partial(
+                paged_attention_prefill, scale=cfg.attn_scale, alibi_slopes=_slopes, window=window)
+            _fns[window] = (decode, prefill, True)
+        return _fns[window]
+
+    return attn_fns
+
+
+def _transformer_layer(cfg: TransformerConfig, lp: Dict, x: jnp.ndarray, k_pages_i: jnp.ndarray,
+                       v_pages_i: jnp.ndarray, slot_mapping: jnp.ndarray, cos, sin, positions: jnp.ndarray,
+                       attn_apply, mods, moe: bool) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One transformer block over (B, S) tokens against this layer's page
+    pool: qkv + rope + KV page write + ``attn_apply(q, kp, vp)`` + FFN.
+    The attention itself is a caller closure so the ragged (single-mode)
+    and fused (mixed decode+prefill) forwards share everything else —
+    one weight read per layer regardless of how rows are batched."""
+    B, S = x.shape[:2]
+    KVH, D = cfg.kv_heads, cfg.head_dim
+    dtype = cfg.dtype
+    h = mods.norm(cfg, _norm_p(cfg, lp, 0), x)
+    q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
+    k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
+    v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
+    if cfg.clip_qkv is not None:  # olmo: clamp projections before rope
+        q, k, v = (jnp.clip(t, -cfg.clip_qkv, cfg.clip_qkv) for t in (q, k, v))
+    if cfg.qk_norm:  # qwen3: per-head rms before rope
+        rms = REGISTRY.get("rms_norm")
+        q = rms(q, lp["attn"]["q_norm"]["scale"], cfg.norm_eps).astype(dtype)
+        k = rms(k, lp["attn"]["k_norm"]["scale"], cfg.norm_eps).astype(dtype)
+    if cfg.pos_emb == "rope":
+        q = apply_rope(q, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
+        k = apply_rope(k, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
+
+    kp, vp = update_kv_pages(k_pages_i, v_pages_i, k.reshape(B * S, KVH, D), v.reshape(B * S, KVH, D),
+                             slot_mapping)
+
+    attn = attn_apply(q, kp, vp)
+    attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
+
+    if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
+        ffn_in = h
+    elif cfg.block_type == "parallel":  # gpt-neox parallel residual
+        ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
+    else:
+        x = x + attn_out
+        ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
+    ffn_out = mods.moe(cfg, lp["moe"], ffn_in) if moe else mods.mlp(cfg, lp["mlp"], ffn_in)
+    if cfg.block_type in ("parallel", "parallel_shared"):
+        x = x + attn_out + ffn_out
+    else:
+        x = x + ffn_out
+    return x, kp, vp
 
 
 def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
@@ -55,41 +148,8 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     last_token_idx: (B,) index of the last real (non-pad) token per row.
     Returns (last-real-token logits (B, V), k_pages, v_pages).
     """
-    B, S = input_ids.shape
-    H, KVH, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
-    dtype = cfg.dtype
-
-    if mesh is not None and tp > 1:
-        # heads split over `tensor`: each shard decodes its own heads
-        # against its KV-page shard (ref v2 sharding helpers). Per-shard
-        # slope slices aren't expressible as a baked constant, so ALiBi/
-        # window models route through the gather path under TP.
-        tp_decode_attn = shard_map(
-            functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale),
-            mesh=mesh, in_specs=(P(None, "tensor", None), P(None, None, "tensor", None),
-                                 P(None, None, "tensor", None), P(None, None), P(None)),
-            out_specs=P(None, "tensor", None), check_vma=False)
-        attn_fns = lambda window: (tp_decode_attn, None, False)
-    else:
-        # one (decode, prefill) pair per distinct per-layer window value
-        # (gpt-neo alternates global/local; qwen2 windows a layer suffix) —
-        # the layer loop is unrolled, so windows are static per layer and
-        # each value bakes its own kernel variant
-        _slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
-        _fns = {}
-
-        def attn_fns(window):
-            if window not in _fns:
-                decode = functools.partial(paged_attention_decode, interpret=interpret, scale=cfg.attn_scale,
-                                           alibi_slopes=_slopes, window=window)
-                # interpret mode (CPU dev serving) keeps the compute-bound
-                # prefill on the fused XLA gather path — emulating the
-                # page-walk kernel there is strictly slower; on real TPU the
-                # kernel avoids the context gather
-                prefill = None if interpret else functools.partial(
-                    paged_attention_prefill, scale=cfg.attn_scale, alibi_slopes=_slopes, window=window)
-                _fns[window] = (decode, prefill, True)
-            return _fns[window]
+    H = cfg.n_heads
+    attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
 
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids, positions)
@@ -103,46 +163,71 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
 
     for i in range(cfg.n_layers):
         lp = params[f"layer_{i}"]
-        h = mods.norm(cfg, _norm_p(cfg, lp, 0), x)
-        q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
-        k = _proj(h, lp["attn"]["k_proj"], "bsd,dhk->bshk", dtype)
-        v = _proj(h, lp["attn"]["v_proj"], "bsd,dhk->bshk", dtype)
-        if cfg.clip_qkv is not None:  # olmo: clamp projections before rope
-            q, k, v = (jnp.clip(t, -cfg.clip_qkv, cfg.clip_qkv) for t in (q, k, v))
-        if cfg.qk_norm:  # qwen3: per-head rms before rope
-            rms = REGISTRY.get("rms_norm")
-            q = rms(q, lp["attn"]["q_norm"]["scale"], cfg.norm_eps).astype(dtype)
-            k = rms(k, lp["attn"]["k_norm"]["scale"], cfg.norm_eps).astype(dtype)
-        if cfg.pos_emb == "rope":
-            q = apply_rope(q, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
-            k = apply_rope(k, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
+        w_i = cfg.window_for(i)
+        decode_attn, prefill_attn, decode_native = attn_fns(w_i)
 
-        kp, vp = update_kv_pages(k_pages[i], v_pages[i], k.reshape(B * S, KVH, D), v.reshape(B * S, KVH, D),
-                                 slot_mapping)
+        def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
+            return mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
+                                  slopes=slopes, decode_attn=_da, decode_native=_dn,
+                                  prefill_attn=_pa, window=_w)
+
+        x, kp, vp = _transformer_layer(cfg, lp, x, k_pages[i], v_pages[i], slot_mapping, cos, sin,
+                                       positions, attn_apply, mods, _is_moe_layer(cfg, i))
         k_pages = k_pages.at[i].set(kp)
         v_pages = v_pages.at[i].set(vp)
 
+    return mods.unembed(cfg, params, x, last_token_idx), k_pages, v_pages
+
+
+def fused_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
+                  k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray, ctx_lens: jnp.ndarray,
+                  slot_mapping: jnp.ndarray, last_flat: jnp.ndarray, *, n_dec: int, chunk: int,
+                  interpret: bool = False, mesh=None, tp: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """SplitFuse mixed step: decode rows AND chunked-prefill rows in ONE
+    forward over the paged pool — every layer reads its weights once for
+    the whole ragged token batch (the Dynamic SplitFuse point: prefill
+    FLOPs keep decode's weight reads fed, and the host dispatches a
+    single program per scheduler quantum).
+
+    input_ids/positions/slot_mapping: (T,) flat token batch — flat slots
+    [0, n_dec) are single-token decode rows; the remainder is the prefill
+    segment, (n_pre, chunk) row-major. block_tables: (N, P) and
+    ctx_lens/last_flat: (N,) are per-ROW (N = n_dec + n_pre, decode rows
+    first); ``last_flat`` holds the flat index of each row's last real
+    token. Returns ((N, V) fp32 next-token logits, k_pages, v_pages).
+    """
+    attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
+
+    mods = build_modules()
+    x = mods.embedding(cfg, params, input_ids[None], positions[None])  # (1, T, d)
+    cos = sin = None
+    if cfg.pos_emb == "rope":
+        cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
+    slopes = jnp.asarray(alibi_slopes(cfg.n_heads)) if cfg.pos_emb == "alibi" else None
+    pos2d = positions[None]
+
+    for i in range(cfg.n_layers):
+        lp = params[f"layer_{i}"]
         w_i = cfg.window_for(i)
         decode_attn, prefill_attn, decode_native = attn_fns(w_i)
-        attn = mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
-                              slopes=slopes, decode_attn=decode_attn, decode_native=decode_native,
-                              prefill_attn=prefill_attn, window=w_i)
-        attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
 
-        if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
-            ffn_in = h
-        elif cfg.block_type == "parallel":  # gpt-neox parallel residual
-            ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
-        else:
-            x = x + attn_out
-            ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
-        ffn_out = mods.moe(cfg, lp["moe"], ffn_in) if _is_moe_layer(cfg, i) else mods.mlp(cfg, lp["mlp"], ffn_in)
-        if cfg.block_type in ("parallel", "parallel_shared"):
-            x = x + attn_out + ffn_out
-        else:
-            x = x + ffn_out
+        def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
+            out = paged_attention_mixed(q[0], kp, vp, block_tables, ctx_lens, positions,
+                                        n_dec=n_dec, chunk=chunk, scale=cfg.attn_scale,
+                                        alibi_slopes=slopes, window=_w,
+                                        decode_fn=_da, prefill_fn=_pa, native=_dn)
+            return out[None]  # (1, T, H, D)
 
-    return mods.unembed(cfg, params, x, last_token_idx), k_pages, v_pages
+        x, kp, vp = _transformer_layer(cfg, lp, x, k_pages[i], v_pages[i], slot_mapping, cos, sin,
+                                       pos2d, attn_apply, mods, _is_moe_layer(cfg, i))
+        k_pages = k_pages.at[i].set(kp)
+        v_pages = v_pages.at[i].set(vp)
+
+    # per-row last-token hidden states -> (N, 1, d) so the unembed module's
+    # (batch, seq) contract holds for the ragged flat batch
+    x_last = x[0, last_flat][:, None, :]
+    zeros = jnp.zeros((last_flat.shape[0],), jnp.int32)
+    return mods.unembed(cfg, params, x_last, zeros), k_pages, v_pages
 
 
 def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
@@ -191,3 +276,67 @@ def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
         return toks.T, k_pages, v_pages
 
     return jax.jit(burst, donate_argnums=(3, 4))
+
+
+def make_fused_step_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1, *,
+                       n_dec: int, n_pre: int, chunk: int, do_sample: bool = False,
+                       temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+    """ONE dispatched program per scheduler quantum (Dynamic SplitFuse).
+
+    The program runs the mixed prefill+decode pass (``fused_forward``),
+    samples every row's next token on device, then advances the batch
+    ``steps - 1`` further paged-decode steps under ``lax.scan`` — the
+    step count is carried by the (steps-1, N) follow-on slot table's
+    shape, so one jit wrapper serves the whole power-of-two burst ladder.
+    Finished rows (== ``eos_id``; pass -1 to disable) are masked with
+    ``lax.cond``-gated compute (whole-batch early-out) plus garbage-slot
+    KV writes and a frozen token carry, and the only host readback is the
+    final (N, steps) int32 token block — one int per sequence per step.
+
+    ``n_dec``/``n_pre``/``chunk`` are the PADDED bucket shapes (static:
+    they fix the decode/prefill split inside the traced program); the
+    engine LRU-caches one wrapper per (bucket, sampling) signature like
+    the burst programs.
+    """
+    from ..generation import sample_logits
+
+    fwd = functools.partial(fused_forward, cfg, n_dec=n_dec, chunk=chunk,
+                            interpret=interpret, mesh=mesh, tp=tp)
+    dec_fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp)
+    n_rows = n_dec + n_pre
+
+    def fused(params, ids, positions, k_pages, v_pages, block_tables, ctx, slots0, last_flat,
+              adv_slots, garbage_slots, eos_id, rng):
+        # ids/positions/slots0: (T,) flat; block_tables (N, P); ctx/last_flat/
+        # garbage_slots (N,); adv_slots (steps-1, N); eos_id () int32 (-1 = off)
+        logits, k_pages, v_pages = fwd(params, ids, positions, k_pages, v_pages,
+                                       block_tables, ctx, slots0, last_flat)
+        rng, r0 = jax.random.split(rng)
+        tok0 = sample_logits(logits, r0, do_sample, temperature, top_k, top_p).astype(jnp.int32)
+        done0 = tok0 == eos_id
+        zeros_last = jnp.zeros((n_rows,), jnp.int32)
+
+        def step(carry, slots_t):
+            toks, done, kp, vp, off, rng = carry
+            slots_w = jnp.where(done, garbage_slots, slots_t)
+
+            def run(kp, vp):
+                return dec_fwd(params, toks[:, None], (ctx + off)[:, None], kp, vp,
+                               block_tables, ctx + off + 1, slots_w, zeros_last)
+
+            def skip(kp, vp):
+                return jnp.zeros_like(logits), kp, vp
+
+            lg, kp, vp = jax.lax.cond(jnp.all(done), skip, run, kp, vp)
+            rng, r = jax.random.split(rng)
+            nxt = sample_logits(lg, r, do_sample, temperature, top_k, top_p).astype(jnp.int32)
+            nxt = jnp.where(done, toks, nxt)  # finished rows repeat their eos
+            done = done | (nxt == eos_id)
+            return (nxt, done, kp, vp, off + 1, rng), nxt
+
+        carry0 = (tok0, done0, k_pages, v_pages, jnp.int32(0), rng)
+        (_, _, k_pages, v_pages, _, _), rest = jax.lax.scan(step, carry0, adv_slots)
+        toks = jnp.concatenate([tok0[:, None], rest.T], axis=1)  # (N, steps)
+        return toks, k_pages, v_pages
+
+    return jax.jit(fused, donate_argnums=(3, 4))

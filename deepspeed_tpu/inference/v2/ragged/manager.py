@@ -10,6 +10,8 @@ per-layer page arrays updated functionally under jit with donation).
 from dataclasses import dataclass
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ....telemetry import get_registry as get_telemetry_registry
 from ....utils.logging import logger
 from .blocked_allocator import BlockedAllocator
@@ -99,6 +101,17 @@ class DSStateManager:
 
     def can_allocate(self, num_blocks: int) -> bool:
         return num_blocks <= self._allocator.free_blocks
+
+    def block_table_row(self, seq: Optional[DSSequenceDescriptor], width: int,
+                        fill_block: int = 0) -> np.ndarray:
+        """Fixed-width block-table row for a (possibly mixed/fused) batch:
+        the sequence's blocks left-aligned, padded with ``fill_block``
+        (the engine's garbage page, so padded table slots always map to
+        real pool memory). ``seq=None`` (a padding row) is all fill."""
+        row = np.full((width,), fill_block, np.int32)
+        if seq is not None and seq.blocks:
+            row[:len(seq.blocks)] = seq.blocks
+        return row
 
     def flush_sequence(self, uid: int) -> None:
         """Retire a sequence and return its blocks to the pool."""

@@ -34,6 +34,7 @@ class ScheduledPrefill:
     uid: int
     tokens: List[int]
     start_pos: int
+    final: bool = False  # last chunk of the prompt: this row emits a token
 
 
 @dataclass
@@ -44,6 +45,30 @@ class ScheduledStep:
     @property
     def empty(self) -> bool:
         return not self.prefills and not self.decode_uids
+
+
+@dataclass
+class FusedQuantum:
+    """One fused scheduler quantum: the ragged-batch descriptor the
+    single-dispatch serving step consumes. Rows are decode-first; each
+    prefill row carries its per-row (start, len, is_final) metadata via
+    ``ScheduledPrefill`` (start_pos / len(tokens) / final) — together
+    with the decode uids this is the (start, len, is_prefill) table the
+    SplitFuse step lays out as one flat token batch."""
+    prefills: List[ScheduledPrefill]
+    decode_uids: List[int]
+
+    @property
+    def empty(self) -> bool:
+        return not self.prefills and not self.decode_uids
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.prefills) + len(self.decode_uids)
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.decode_uids) + sum(len(p.tokens) for p in self.prefills)
 
 
 class RaggedBatchScheduler:
@@ -59,6 +84,7 @@ class RaggedBatchScheduler:
         self._m_step_tokens = tele.gauge("sched_step_tokens")
         self._m_decodes = tele.counter("sched_decodes_total")
         self._m_prefill_chunks = tele.counter("sched_prefill_chunks_total")
+        self._m_quantum_rows = tele.gauge("sched_quantum_rows")
 
     def schedule(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> ScheduledStep:
         """Pick the work for one engine step.
@@ -100,10 +126,21 @@ class RaggedBatchScheduler:
             free -= max(0, need)
             budget -= take
             seqs += 1
-            prefills.append(ScheduledPrefill(uid=req.uid, tokens=req.tokens[:take], start_pos=seq.seen_tokens))
+            prefills.append(ScheduledPrefill(uid=req.uid, tokens=req.tokens[:take], start_pos=seq.seen_tokens,
+                                             final=take == req.remaining_prefill))
 
         self._m_queue_depth.set(len(pending_prefills))
         self._m_step_tokens.set(self.max_batch_tokens - budget)
         self._m_decodes.inc(len(sched_decodes))
         self._m_prefill_chunks.inc(len(prefills))
         return ScheduledStep(prefills=prefills, decode_uids=sched_decodes)
+
+    def schedule_fused(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> FusedQuantum:
+        """Assemble one fused quantum: identical admission policy to
+        ``schedule`` (decode priority, FIFO chunked prefill, block
+        back-pressure), repackaged as the ragged-batch descriptor the
+        single-dispatch SplitFuse step consumes."""
+        step = self.schedule(pending_prefills, decode_uids)
+        q = FusedQuantum(prefills=step.prefills, decode_uids=step.decode_uids)
+        self._m_quantum_rows.set(q.n_rows)
+        return q

@@ -126,6 +126,8 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
         else:
             decode_ready[uid] = toks_out[-1]
 
+    fused = bool(getattr(engine, "_fused_enabled", False))
+
     while next_idx < spec.n_requests or pending or decode_ready:
         admit_arrivals()
         if not pending and not decode_ready:
@@ -133,6 +135,29 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             time.sleep(max(0.0, arrivals[next_idx] - now()))
             continue
         arrivals_due = next_idx < spec.n_requests and arrivals[next_idx] <= now()
+        if fused:
+            # SplitFuse hot path: one dispatched program per scheduler
+            # quantum. Pure-decode quanta with nothing due extend to a
+            # fused multi-step burst inside the same program — same
+            # TTFT-for-throughput trade as the legacy burst path below,
+            # measured the same way.
+            quantum = engine.scheduler.schedule_fused([r for r in pending if r.remaining_prefill],
+                                                      list(decode_ready))
+            if quantum.empty:
+                raise RuntimeError("scheduler deadlock: no work schedulable (KV pool too small?)")
+            for pf in quantum.prefills:
+                reqs[pf.uid].tokens = reqs[pf.uid].tokens[len(pf.tokens):]
+            steps = 1
+            if quantum.decode_uids and not quantum.prefills and not pending and not arrivals_due:
+                rem = min(reqs[u].max_new_tokens - len(results[u]) for u in quantum.decode_uids)
+                steps = max(1, engine._burst_steps({u: True for u in quantum.decode_uids}, rem))
+            carry = [decode_ready.pop(u) for u in quantum.decode_uids]
+            rows = engine._run_fused(quantum, carry, steps, False, eos_token_id)
+            for uid, row in rows.items():
+                if row is not None:
+                    commit(uid, row.tolist())
+            pending = [r for r in pending if not r.done and r.remaining_prefill]
+            continue
         if not pending and not arrivals_due and decode_ready:
             # burst path: everyone is decoding and nothing is due — K fused
             # steps on-device. A request arriving mid-burst waits it out;

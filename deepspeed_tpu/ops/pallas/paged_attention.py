@@ -97,6 +97,65 @@ def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarr
 
 
 # ------------------------------------------------------------------
+# Mixed decode+prefill dispatch (SplitFuse fused serving step)
+# ------------------------------------------------------------------
+def paged_attention_mixed(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarray,
+                          block_tables: jnp.ndarray, ctx_lens: jnp.ndarray, q_positions: jnp.ndarray, *,
+                          n_dec: int, chunk: int, scale: Optional[float] = None,
+                          alibi_slopes=None, window: Optional[int] = None,
+                          decode_fn=None, prefill_fn=None, native: bool = True) -> jnp.ndarray:
+    """Serve decode rows and chunked-prefill rows from the paged pool in
+    one attention pass of a single traced program.
+
+    q: (T, H, D) flat query tokens — rows [0, n_dec) are single-token
+    decode rows; the remainder is the (n_pre, chunk) prefill segment,
+    row-major. block_tables/ctx_lens are per-ROW with N = n_dec + n_pre
+    (decode rows first); q_positions: (T,) absolute positions (decode
+    rows sit at ctx - 1). Returns (T, H, D).
+
+    The shapes unify into ONE kernel launch when either segment is empty
+    or when ``chunk == 1`` (a one-token prefill chunk queries at ctx - 1,
+    which is exactly the decode contract); otherwise the decode and
+    prefill kernels launch back to back inside the caller's jitted
+    program — still a single host dispatch either way.
+
+    ``decode_fn``/``prefill_fn``: pre-bound kernel variants (ALiBi/window
+    baked when ``native``); falls back to the gather reference otherwise,
+    mirroring the v2 attention module's routing.
+    """
+    T, H, D = q.shape
+    n_pre = (T - n_dec) // chunk if chunk else 0
+    plain = alibi_slopes is None and window is None
+    sl = jnp.asarray(alibi_slopes, jnp.float32) if alibi_slopes is not None else None
+
+    def run_decode(qd, bt, cl):
+        if decode_fn is not None and (native or plain):
+            return decode_fn(qd, k_pages, v_pages, bt, cl)
+        return paged_attention_ref(qd[:, None], k_pages, v_pages, bt, cl, (cl - 1)[:, None],
+                                   scale, alibi_slopes=sl, window=window)[:, 0]
+
+    def run_prefill(qp, bt, cl, pos):
+        if prefill_fn is not None and (native or plain):
+            return prefill_fn(qp, k_pages, v_pages, bt, cl, pos)
+        return paged_attention_ref(qp, k_pages, v_pages, bt, cl, pos, scale,
+                                   alibi_slopes=sl, window=window)
+
+    if n_pre == 0 or chunk == 1:
+        # pure decode, or every prefill row is a single token at ctx - 1:
+        # ONE decode launch covers the whole batch
+        return run_decode(q, block_tables, ctx_lens)
+    if n_dec == 0:
+        qp = q.reshape(n_pre, chunk, H, D)
+        return run_prefill(qp, block_tables, ctx_lens,
+                           q_positions.reshape(n_pre, chunk)).reshape(T, H, D)
+    o_dec = run_decode(q[:n_dec], block_tables[:n_dec], ctx_lens[:n_dec])
+    qp = q[n_dec:].reshape(n_pre, chunk, H, D)
+    o_pre = run_prefill(qp, block_tables[n_dec:], ctx_lens[n_dec:],
+                        q_positions[n_dec:].reshape(n_pre, chunk))
+    return jnp.concatenate([o_dec, o_pre.reshape(n_pre * chunk, H, D)], axis=0)
+
+
+# ------------------------------------------------------------------
 # Pallas decode kernel
 # ------------------------------------------------------------------
 def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref, acc_ref, m_ref, l_ref,

@@ -113,6 +113,50 @@ def test_failed_experiments_pruned():
     with pytest.raises(RuntimeError):
         at2.tune(stages=[0], micro_batches=[1])
 
+def test_b64_cache_keys_on_file_identity(tmp_path):
+    # regression: the cache was keyed on path alone, so a capture npz
+    # rewritten between trials shipped the STALE payload to remote hosts
+    import base64
+
+    from deepspeed_tpu.autotuning import TrialScheduler
+
+    npz = tmp_path / "batches.npz"
+    npz.write_bytes(b"AAA")
+    sched = TrialScheduler(n_workers=1)
+    assert base64.b64decode(sched._b64_for(str(npz))) == b"AAA"
+    assert base64.b64decode(sched._b64_for(str(npz))) == b"AAA"  # cache hit
+    npz.write_bytes(b"BBBB")  # same path, new contents (size change forces a new sig
+    # even where mtime granularity is coarse)
+    assert base64.b64decode(sched._b64_for(str(npz))) == b"BBBB"
+
+
+def test_piped_local_slot_uses_sys_executable(monkeypatch):
+    # regression: a no-prefix piped launch ran a guessed "python3" from
+    # PATH (possibly a different venv) instead of the running interpreter
+    import sys as _sys
+
+    import deepspeed_tpu.autotuning.scheduler as sched_mod
+    from deepspeed_tpu.autotuning import TrialScheduler
+
+    captured = []
+
+    def fake_run(cmd, **kw):
+        captured.append(list(cmd))
+
+        class P:
+            returncode = 0
+            stdout = b""
+            stderr = b""
+        return P()
+
+    monkeypatch.setattr(sched_mod.subprocess, "run", fake_run)
+    sched = TrialScheduler(n_workers=1)
+    sched._run_piped({"model": {}}, [], {})
+    assert captured[-1][0] == _sys.executable
+    sched._run_piped({"model": {}}, ["ssh", "host2"], {})
+    assert captured[-1][:3] == ["ssh", "host2", "python3"]
+
+
 def test_hostfile_prefixes(tmp_path):
     from deepspeed_tpu.autotuning import ssh_prefixes_from_hostfile
 

@@ -17,11 +17,11 @@ print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$LO
     exit 1
 fi
 
-note "1/3 hw_smoke flash+ring (scale-aware tolerance, ring first TPU compile)"
+note "1/4 hw_smoke flash+ring (scale-aware tolerance, ring first TPU compile)"
 timeout 1200 python tools/hw_smoke.py flash ring >> "$LOG" 2>&1
 note "smoke rc=$?"
 
-note "2/3 serve rung with deferred serving loop"
+note "2/4 serve rung with deferred serving loop"
 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1800 python bench.py >> "$LOG" 2>&1
 note "serve rc=$?"
 

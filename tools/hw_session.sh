@@ -1,14 +1,35 @@
 #!/bin/bash
 # One-shot TPU hardware session: run everything worth measuring in
-# sequence, tolerating individual failures, with incremental artifacts.
-# Protocol (PERF_NOTES.md): health-check first, one long-lived process
-# per step, never SIGKILL mid-compile.
+# sequence, tolerating individual step failures, with incremental
+# artifacts. Protocol (PERF_NOTES.md): health-check first, one
+# long-lived process per step, never SIGKILL mid-compile.
+#
+# Two hard lessons baked in:
+# - the tunnel dies silently mid-session: a 20s tiny-matmul liveness
+#   probe runs between EVERY phase and ABORTS the session on failure,
+#   so a dead tunnel costs seconds, not an hour of wedged timeouts
+#   with every later artifact silently missing;
+# - chip windows die early: rungs with ZERO hardware evidence (attn,
+#   attn_d64, longctx, serve_sla, int8/int4 A/B — never measured on a
+#   real chip) run FIRST; re-measures of known-good numbers (full
+#   ladder, train sweep) spend whatever window is left.
 cd "$(dirname "$0")/.." || exit 1
 LOG=${1:-hw_session.log}
 : > "$LOG"
 
 note() { echo "[hw_session $(date +%H:%M:%S)] $*" | tee -a "$LOG"; }
 
+probe() {
+    note "liveness probe (tiny matmul, 20s budget)"
+    if ! timeout 20 python -c "
+import jax, jax.numpy as jnp
+print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$LOG" 2>&1; then
+        note "tunnel DEAD - aborting session (finished artifacts are already on disk)"
+        exit 1
+    fi
+}
+
+# first probe gets a long budget: it also pays backend/tunnel init
 note "health check (tiny matmul, 110s budget)"
 if ! timeout 110 python -c "
 import jax, jax.numpy as jnp
@@ -17,23 +38,45 @@ print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$LO
     exit 1
 fi
 
-note "1/3 hw_smoke (every Pallas kernel incl. quantized_matmul, on-chip parity)"
+# ---- phase A: never-measured rungs (zero hardware evidence) ----
+i=0
+for rung in attn attn_d64 longctx serve_sla; do
+    i=$((i+1))
+    note "A$i/4 bench rung $rung (never measured on-chip)"
+    DS_BENCH_EXTRA=0 DS_BENCH_RUNG=$rung timeout 1800 python bench.py >> "$LOG" 2>&1
+    note "$rung rc=$?"
+    probe
+done
+
+note "A5 int8 weight-only A/B (decode + serve rungs)"
+DS_BENCH_QUANT=8 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
+note "int8 decode rc=$?"
+DS_BENCH_QUANT=8 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
+note "int8 serve rc=$?"
+probe
+
+note "A6 int4 weight-only A/B (decode + serve rungs, packed storage)"
+DS_BENCH_QUANT=4 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
+note "int4 decode rc=$?"
+DS_BENCH_QUANT=4 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
+note "int4 serve rc=$?"
+probe
+
+# ---- phase B: kernel smoke + known-good re-measures ----
+note "B1/3 hw_smoke (every Pallas kernel incl. quantized_matmul, on-chip parity)"
 timeout 1800 python tools/hw_smoke.py >> "$LOG" 2>&1
 note "hw_smoke rc=$?"
+probe
 
-note "2/3 bench.py full ladder (zero2 + zero3/decode/serve/attn/longctx extras -> BENCH_extra.json)"
+note "B2/3 bench.py full ladder (zero2 + zero3/decode/serve/attn/longctx extras -> BENCH_extra.json)"
 timeout 3600 python bench.py >> "$LOG" 2>&1
 note "bench rc=$?"
+probe
 
-note "3/4 int8 weight-only A/B (decode + serve rungs)"
-DS_BENCH_QUANT=1 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
-note "quant decode rc=$?"
-DS_BENCH_QUANT=1 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
-note "quant serve rc=$?"
-
-note "4/4 train flag/block sweep (TRAIN_SWEEP.jsonl)"
+note "B3/3 train flag/block sweep (TRAIN_SWEEP.jsonl)"
 bash tools/train_sweep.sh >> "$LOG" 2>&1
 note "train sweep rc=$?"
+probe
 
 python tools/hw_summary.py > HW_SUMMARY.txt 2>&1
-note "session complete - artifacts: BENCH_extra.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + $LOG"
+note "session complete - artifacts: BENCH_extra.json + BENCH_SLA.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + $LOG"

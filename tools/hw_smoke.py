@@ -45,17 +45,24 @@ def _ops():
                                   .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
             gx = jax.jit(jax.grad(lambda q, k, v: attention_xla(q, k, v, causal=True, **kw)
                                   .astype(jnp.float32).sum(), argnums=(0, 1, 2)))(q, kg, vg)
-            for name, a, b in zip(("dq", "dk", "dv"), gf, gx):
-                # Both sides are bf16: tolerance must scale with magnitude
-                # (dv reaches ~30 here; one bf16 ulp at 30 is 0.125, which a
-                # fixed 0.1 abs threshold mis-flagged as a kernel bug in the
-                # round-5 chip session — tools/debug_flash_gqa.py showed the
-                # kernel closer to fp32 than the oracle itself).
-                a = a.astype(jnp.float32)
-                b = b.astype(jnp.float32)
-                d = float(jnp.max(jnp.abs(a - b)))
-                tol = 0.01 * max(1.0, float(jnp.max(jnp.abs(b))))
-                assert d < tol, f"flash GQA {name} mismatch {kw}: {d} (tol {tol})"
+            # Measured tolerance: both contestants are bf16, so judge each
+            # against the fp32 XLA oracle on fp32 inputs. The kernel fails
+            # only if its fp32-truth error clearly exceeds the bf16 XLA
+            # path's own fp32-truth error (2.5x headroom) — a real kernel
+            # bug is orders of magnitude off, while the round-5 chip
+            # session's fixed-threshold flags were pure bf16 rounding
+            # (tools/debug_flash_gqa.py showed the kernel CLOSER to fp32
+            # than the oracle at the flagged entries).
+            g32 = jax.jit(jax.grad(lambda q, k, v: attention_xla(q, k, v, causal=True, **kw).sum(),
+                                   argnums=(0, 1, 2)))(q.astype(jnp.float32), kg.astype(jnp.float32),
+                                                       vg.astype(jnp.float32))
+            for name, a, b, o in zip(("dq", "dk", "dv"), gf, gx, g32):
+                o = o.astype(jnp.float32)
+                err_kernel = float(jnp.max(jnp.abs(a.astype(jnp.float32) - o)))
+                err_oracle = float(jnp.max(jnp.abs(b.astype(jnp.float32) - o)))
+                tol = 2.5 * max(err_oracle, 1e-6)
+                assert err_kernel <= tol, \
+                    f"flash GQA {name} vs fp32 {kw}: kernel {err_kernel} > 2.5x xla-bf16 {err_oracle}"
 
     def sparse():
         from deepspeed_tpu.ops.sparse_attention import BigBirdSparsityConfig, FixedSparsityConfig, sparse_attention
