@@ -85,6 +85,17 @@ RUNG_CONTRACTS = {
         "accounting": "same HBM-bound derivation as decode plus scheduling overhead",
         "baseline_tokens_per_sec_chip": 25000.0,
     },
+    "serve_prefix": {
+        "model": "gpt2-124M bf16, v2 ragged engine, shared-system-prompt workload: "
+                 "requests share a 512-token prefix + unique 16..64 tails, 64 new tokens",
+        "measure": "warm-wave serving tokens/s/chip with the radix prefix cache on "
+                   "(DS_TPU_PREFIX_CACHE): a cold wave populates the cache, a second wave "
+                   "of fresh requests over the same system prompt is timed; prefix_hit_rate "
+                   "and cached_token_fraction reported beside",
+        "accounting": "same HBM-bound 25k tok/s/chip denominator as serve; the cache's win "
+                      "is prefill FLOPs and TTFT, visible in prefill_tokens vs prompt_tokens",
+        "baseline_tokens_per_sec_chip": 25000.0,
+    },
     "serve_sla": {
         "model": "gpt2-124M bf16, v2 ragged engine under Poisson open-loop load",
         "measure": "effective tokens/s at SLA: best rate row with <=1% SLA misses "
@@ -120,6 +131,7 @@ FROZEN_HASHES = {
     "zero3": "68f02dbbe3404e65",
     "decode": "c9c5e4e408065244",
     "serve": "e39f632039a0821a",
+    "serve_prefix": "0ba166fb0198ffb6",
     "serve_sla": "4ef79dd1d8c8501c",
     "attn": "779084b20083fd56",
     "attn_d64": "73ea8908662973d7",
@@ -300,19 +312,85 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
     prompts = [rng.randint(0, cfg_model.vocab_size, size=(int(l),)).tolist() for l in lens]
     eng.generate(prompts, max_new_tokens=new_tokens)  # compile every bucket/burst shape
     from deepspeed_tpu.telemetry import get_registry
-    disp = get_registry().counter("infer_dispatches_total")
-    d0 = disp.value
+    reg = get_registry()
+    disp = reg.counter("infer_dispatches_total")
+    hits = reg.counter("kv_prefix_hits_total")
+    hit_toks = reg.counter("kv_prefix_hit_tokens_total")
+    d0, h0, ht0 = disp.value, hits.value, hit_toks.value
     t0 = time.perf_counter()
     out = eng.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0
     assert all(len(o) == new_tokens for o in out)
     served = n_prompts * new_tokens
-    # dispatch accounting: the fused serving loop's headline is programs
-    # per served token (docs/SERVING.md); rides the result dict as extra
-    # keys — contracts and their frozen hashes are untouched
+    prompt_toks = sum(len(p) for p in prompts)
+    # dispatch + prefix-cache accounting: programs per served token
+    # (docs/SERVING.md) and how much prompt KV the radix cache reused;
+    # rides the result dict as extra keys — contracts and their frozen
+    # hashes are untouched. (The default kv_block_size of 128 means short
+    # CPU-smoke prompts rarely fill a block; serve_prefix is the rung that
+    # actually exercises the cache.)
     return served / dt, {"dispatches": int(disp.value - d0),
                          "tokens_per_dispatch": round(served / max(1, disp.value - d0), 2),
-                         "fused": eng._fused_enabled}
+                         "fused": eng._fused_enabled,
+                         "prefix_hit_rate": round((hits.value - h0) / n_prompts, 4),
+                         "cached_token_fraction": round((hit_toks.value - ht0) / max(1, prompt_toks), 4)}
+
+
+def run_serve_prefix(jax, jnp, np, cfg_model, platform):
+    """Shared-system-prompt serving with the radix prefix cache
+    (contract: RUNG_CONTRACTS['serve_prefix']; docs/SERVING.md).
+
+    Two waves of requests share one system prompt: the cold wave pays its
+    prefill and populates the radix tree on flush, then a warm wave of
+    FRESH requests (same system prompt, unique tails) is timed — each warm
+    admission matches the cached prefix and prefills only its tail."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import CausalLM
+    from deepspeed_tpu.telemetry import get_registry
+
+    if platform == "tpu":
+        n_req, shared_len, tlo, thi, new_toks, kv_bs = 32, 512, 16, 64, 64, 128
+    else:
+        n_req, shared_len, tlo, thi, new_toks, kv_bs = 4, 24, 2, 6, 6, 8
+    model = CausalLM(cfg_model)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    max_ctx = min(cfg_model.max_seq_len, shared_len + thi + new_toks + kv_bs)
+    smc = RaggedBatchConfig(max_context=max_ctx, kv_block_size=kv_bs)
+    # both waves' sequences plus the cached system prefix must fit
+    smc.num_kv_blocks = (n_req + 2) * (-(-max_ctx // kv_bs)) + 8
+    eng = InferenceEngineV2(model, params,
+                            RaggedInferenceEngineConfig(state_manager=smc, dtype="bf16",
+                                                        enable_prefix_cache=True))
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg_model.vocab_size, size=shared_len).tolist()
+
+    def wave():
+        lens = rng.randint(tlo, thi + 1, size=n_req)
+        return [shared + rng.randint(0, cfg_model.vocab_size, size=int(l)).tolist() for l in lens]
+
+    eng.generate(wave(), max_new_tokens=new_toks)  # cold: compiles + populates the tree
+    reg = get_registry()
+    hits = reg.counter("kv_prefix_hits_total")
+    hit_toks = reg.counter("kv_prefix_hit_tokens_total")
+    pre_toks = reg.counter("infer_prefill_tokens_total")
+    warm = wave()
+    h0, ht0, p0 = hits.value, hit_toks.value, pre_toks.value
+    t0 = time.perf_counter()
+    out = eng.generate(warm, max_new_tokens=new_toks)
+    dt = time.perf_counter() - t0
+    assert all(len(o) == new_toks for o in out)
+    served = n_req * new_toks
+    prompt_toks = sum(len(p) for p in warm)
+    reused = int(hit_toks.value - ht0)
+    return served / dt, {
+        "prefix_hit_rate": round((hits.value - h0) / n_req, 4),
+        "cached_token_fraction": round(reused / max(1, prompt_toks), 4),
+        "prefix_hit_tokens": reused,
+        "prefill_tokens": int(pre_toks.value - p0),  # dispatched; < prompt_tokens when warm
+        "prompt_tokens": prompt_toks,
+        "cached_blocks": eng.state.prefix_cache.cached_blocks,
+    }
 
 
 def _probe_backend(timeout_s: float = 180.0):
@@ -461,6 +539,16 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             "vs_baseline": round(tps / baseline, 4),
             **disp,
         }
+    if rung == "serve_prefix":
+        tps, extra = run_serve_prefix(jax, jnp, np, cfg_model, platform)
+        baseline = RUNG_CONTRACTS["serve_prefix"]["baseline_tokens_per_sec_chip"]
+        return {
+            "metric": f"gpt2-125m_bf16_serve_shared_prefix_tokens_per_sec_per_chip{tag}",
+            "value": round(tps, 1),
+            "unit": "tokens/s/chip",
+            "vs_baseline": round(tps / baseline, 4),
+            **extra,
+        }
     if rung == "serve_sla":
         eff, rows = run_serve_sla(jax, jnp, np, cfg_model, platform)
         baseline = RUNG_CONTRACTS["serve_sla"]["baseline_tokens_per_sec_chip"]
@@ -537,7 +625,7 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
 
 def main():
     rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
-    known = ("zero2", "zero3", "decode", "serve", "serve_sla", "attn", "attn_d64", "longctx")
+    known = ("zero2", "zero3", "decode", "serve", "serve_prefix", "serve_sla", "attn", "attn_d64", "longctx")
     if rung not in known:
         print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected {' | '.join(known)}", file=sys.stderr)
         return 1

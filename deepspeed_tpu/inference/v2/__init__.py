@@ -9,7 +9,8 @@ prefill/decode split into two jitted bucketed programs instead of one
 CUDA ragged kernel suite.
 """
 
-from .ragged import BlockedAllocator, DSSequenceDescriptor, DSStateManager, RaggedBatchConfig
+from .ragged import (BlockedAllocator, DSSequenceDescriptor, DSStateManager, PrefixCache,
+                     RaggedBatchConfig)
 from .scheduler import RaggedRequest, RaggedBatchScheduler
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
 from .sla import LoadSpec, RequestStat, effective_throughput_at_sla, run_load, summarize, sweep
@@ -17,6 +18,7 @@ from .sla import LoadSpec, RequestStat, effective_throughput_at_sla, run_load, s
 __all__ = [
     "BlockedAllocator",
     "DSSequenceDescriptor",
+    "PrefixCache",
     "DSStateManager",
     "RaggedBatchConfig",
     "RaggedRequest",

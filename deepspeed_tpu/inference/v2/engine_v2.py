@@ -51,6 +51,9 @@ class RaggedInferenceEngineConfig:
     fused_step: Optional[bool] = None  # ONE dispatched program per scheduler quantum (SplitFuse
     # mixed prefill+decode). None: on unless DS_TPU_SERVE_FUSED=0; the unfused
     # per-phase dispatch loop stays available as the fallback.
+    enable_prefix_cache: Optional[bool] = None  # radix prefix cache: retired prompts keep their
+    # KV blocks in a radix tree, new requests skip prefilling a cached prefix
+    # (docs/SERVING.md). None: on unless DS_TPU_PREFIX_CACHE=0.
     min_decode_bucket: int = 8  # floor for the padded decode batch: fewer compiled
     # (B, steps) shapes (padded rows write to the garbage page, so a bigger
     # bucket costs nothing real); 1 restores exact power-of-two bucketing
@@ -128,7 +131,7 @@ class InferenceEngineV2:
             bytes_per_block = (2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * cfg.head_dim *
                                jnp.dtype(self.dtype).itemsize)
             n_blocks = max(8, int(smc.memory_gb * (1 << 30) // bytes_per_block))
-        self.state = DSStateManager(smc, n_blocks)
+        self.state = DSStateManager(smc, n_blocks, enable_prefix_cache=config.enable_prefix_cache)
         self.scheduler = RaggedBatchScheduler(self.state, max_batch_tokens=smc.max_ragged_batch_size,
                                               max_sequences=smc.max_ragged_sequence_count)
 
@@ -184,6 +187,7 @@ class InferenceEngineV2:
         self._run_cfg, self._interpret, self._run_mesh = run_cfg, interpret, run_mesh
         self._bursts: Dict[tuple, object] = {}  # sampling signature -> jitted burst
         self._fused_fns: Dict[tuple, object] = {}  # (bucket shape, sampling) -> jitted fused step
+        self._cow_fn = None  # lazily-jitted donated page copy for copy-on-write
         fused = config.fused_step
         if fused is None:
             fused = os.environ.get("DS_TPU_SERVE_FUSED", "1") != "0"
@@ -237,7 +241,9 @@ class InferenceEngineV2:
     def query(self, uid: int, max_request_length: int) -> Tuple[int, int]:
         """(max new tokens schedulable, free KV blocks). Reference engine_v2.py:184."""
         seq = self.state.get_sequence(uid)
-        free_tokens = self.state.free_blocks * self.state.block_size
+        # feasibility plans against free + cache-reclaimable blocks (the
+        # allocator evicts cached prefixes on demand under pressure)
+        free_tokens = self.state.available_blocks * self.state.block_size
         if seq is not None:
             free_tokens += seq.max_context - seq.seen_tokens
         return min(max_request_length, free_tokens), self.state.free_blocks
@@ -327,6 +333,20 @@ class InferenceEngineV2:
         # round-robin within the garbage page so padded writes stay cheap
         return (self._garbage_block * self.state.block_size + np.arange(n) % self.state.block_size).astype(np.int32)
 
+    def _copy_block(self, src: int, dst: int) -> None:
+        """Copy-on-write page copy: duplicate block ``src`` into ``dst``
+        across every layer's K/V pool. Jitted with donation so the pools
+        update in place; src/dst are traced scalars, so one compiled
+        program serves every copy."""
+        if self._cow_fn is None:
+            self._cow_fn = jax.jit(
+                lambda kp, vp, s, d: (kp.at[:, d].set(kp[:, s]), vp.at[:, d].set(vp[:, s])),
+                donate_argnums=(0, 1))
+        self.k_pages, self.v_pages = self._cow_fn(self.k_pages, self.v_pages, src, dst)
+
+    def _cow_ready(self, seq, start_pos: int) -> None:
+        self.state.ensure_writable(seq, start_pos, self._copy_block)
+
     def _run_prefill_batch(self, uids: List[int], token_lists: List[List[int]], S: int,
                            return_tokens: bool = False, defer: bool = False):
         """Prefill a bucket of sequence chunks (each possibly with prior
@@ -348,7 +368,10 @@ class InferenceEngineV2:
             if seen + len(tokens) > self.state.max_context:
                 raise RuntimeError(f"sequence {uid}: {seen + len(tokens)} tokens exceeds max_context "
                                    f"{self.state.max_context}")
-            total_need += seq.blocks_needed(len(tokens)) if seq is not None else -(-len(tokens) // bs)
+            if seq is not None:
+                total_need += seq.blocks_needed(len(tokens)) + seq.cow_blocks_needed(seen)
+            else:
+                total_need += -(-len(tokens) // bs)
         if not self.state.can_allocate(total_need):
             raise RuntimeError(f"prefill bucket needs {total_need} KV blocks, "
                                f"{self.state.free_blocks} free")
@@ -361,7 +384,9 @@ class InferenceEngineV2:
         seqs = []
         for j, (uid, tokens) in enumerate(zip(uids, token_lists)):
             seq = self.state.get_or_create_sequence(uid)
+            self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, len(tokens))
+            seq.record_tokens(tokens)
             seq.pre_forward(len(tokens))
             start, m = seq.seen_tokens, len(tokens)
             ids[j, :m] = tokens
@@ -414,7 +439,9 @@ class InferenceEngineV2:
         step_idx = np.arange(steps)
         for j, (uid, tok) in enumerate(zip(uids, tokens)):
             seq = self.state.get_sequence(uid)
+            self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, steps)
+            seq.record_tokens(None)  # decode ids may be device-side: freeze the log
             seq.pre_forward(steps)
             pos0 = seq.seen_tokens
             ids[j, 0] = tok
@@ -570,14 +597,17 @@ class InferenceEngineV2:
             if seq.seen_tokens + seq.in_flight_tokens + steps > self.state.max_context:
                 raise RuntimeError(f"sequence {uid}: {seq.seen_tokens + steps} tokens exceeds "
                                    f"max_context {self.state.max_context}")
-            total_need += seq.blocks_needed(steps)
+            total_need += seq.blocks_needed(steps) + seq.cow_blocks_needed(seq.seen_tokens)
         for pf in prefills:
             seq = self.state.get_sequence(pf.uid)
             seen = (seq.seen_tokens + seq.in_flight_tokens) if seq is not None else 0
             if seen + len(pf.tokens) > self.state.max_context:
                 raise RuntimeError(f"sequence {pf.uid}: {seen + len(pf.tokens)} tokens exceeds "
                                    f"max_context {self.state.max_context}")
-            total_need += seq.blocks_needed(len(pf.tokens)) if seq is not None else -(-len(pf.tokens) // bs)
+            if seq is not None:
+                total_need += seq.blocks_needed(len(pf.tokens)) + seq.cow_blocks_needed(seen)
+            else:
+                total_need += -(-len(pf.tokens) // bs)
         if not self.state.can_allocate(total_need):
             raise RuntimeError(f"fused quantum needs {total_need} KV blocks, "
                                f"{self.state.free_blocks} free")
@@ -595,7 +625,9 @@ class InferenceEngineV2:
 
         for j, uid in enumerate(dec_uids):
             seq = self.state.get_sequence(uid)
+            self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, steps)
+            seq.record_tokens(None)  # decode ids may be device-side: freeze the log
             seq.pre_forward(steps)
             pos0 = seq.seen_tokens
             blocks = np.asarray(seq.blocks, np.int32)
@@ -614,7 +646,9 @@ class InferenceEngineV2:
         for r, pf in enumerate(prefills):
             seq = self.state.get_or_create_sequence(pf.uid)
             m = len(pf.tokens)
+            self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, m)
+            seq.record_tokens(pf.tokens)
             seq.pre_forward(m)
             start = seq.seen_tokens
             blocks = np.asarray(seq.blocks, np.int32)

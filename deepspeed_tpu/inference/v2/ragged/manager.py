@@ -5,16 +5,26 @@ Parity: reference ``inference/v2/ragged/ragged_manager.py``
 descriptor table; hands out / reclaims KV blocks as sequences grow and
 retire. The device-side KV pages themselves live in the engine (stacked
 per-layer page arrays updated functionally under jit with donation).
+
+With the prefix cache enabled (``DS_TPU_PREFIX_CACHE``, default on) the
+manager sits between the allocator and the scheduler: admission matches
+a new sequence's prompt against the radix tree (``admit_sequence``),
+retiring sequences donate their block-aligned prefixes back to the tree
+(``flush_sequence``), and writes into cache-shared blocks go through
+copy-on-write (``ensure_writable``).
 """
 
+import os
 from dataclasses import dataclass
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from ....telemetry import get_registry as get_telemetry_registry
+from ....telemetry import span as telemetry_span
 from ....utils.logging import logger
 from .blocked_allocator import BlockedAllocator
+from .prefix_cache import PrefixCache
 from .sequence_descriptor import DSSequenceDescriptor
 
 
@@ -28,14 +38,22 @@ class RaggedBatchConfig:
     kv_block_size: int = 128
     num_kv_blocks: Optional[int] = None  # None => engine sizes from memory_gb
     memory_gb: float = 4.0  # KV pool budget when num_kv_blocks is None
+    prefix_cache_watermark: float = 0.05  # eviction drains to this free fraction
 
 
 class DSStateManager:
 
-    def __init__(self, config: RaggedBatchConfig, num_kv_blocks: int):
+    def __init__(self, config: RaggedBatchConfig, num_kv_blocks: int,
+                 enable_prefix_cache: Optional[bool] = None):
         self._config = config
         self._allocator = BlockedAllocator(num_kv_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        if enable_prefix_cache is None:
+            enable_prefix_cache = os.environ.get("DS_TPU_PREFIX_CACHE", "1") != "0"
+        self._prefix_cache: Optional[PrefixCache] = None
+        if enable_prefix_cache:
+            self._prefix_cache = PrefixCache(self._allocator, config.kv_block_size,
+                                             watermark=config.prefix_cache_watermark)
         # occupancy gauges track the most recently constructed manager
         # (one serving engine per process in practice)
         tele = get_telemetry_registry()
@@ -44,6 +62,7 @@ class DSStateManager:
         self._m_tracked = tele.gauge("kv_tracked_sequences")
         self._m_allocated = tele.counter("kv_blocks_allocated_total")
         self._m_flushed = tele.counter("kv_sequences_flushed_total")
+        self._m_cow = tele.counter("kv_cow_copies_total")
         tele.gauge("kv_blocks_total").set(num_kv_blocks)
         self._sync_gauges()
 
@@ -63,6 +82,16 @@ class DSStateManager:
         return self._allocator.free_blocks
 
     @property
+    def available_blocks(self) -> int:
+        """Free blocks plus cached blocks eviction could reclaim right
+        now — the number admission accounting may plan against (the
+        allocator evicts on demand through the pressure hook)."""
+        n = self._allocator.free_blocks
+        if self._prefix_cache is not None:
+            n += self._prefix_cache.reclaimable_blocks()
+        return n
+
+    @property
     def max_context(self) -> int:
         return self._config.max_context
 
@@ -73,6 +102,10 @@ class DSStateManager:
     @property
     def n_tracked_sequences(self) -> int:
         return len(self._seqs)
+
+    @property
+    def prefix_cache(self) -> Optional[PrefixCache]:
+        return self._prefix_cache
 
     def get_sequence(self, uid: int) -> Optional[DSSequenceDescriptor]:
         return self._seqs.get(uid)
@@ -88,6 +121,55 @@ class DSStateManager:
         self._seqs[uid] = seq
         return seq
 
+    def admit_sequence(self, uid: int, tokens: Sequence[int]) -> DSSequenceDescriptor:
+        """First-sight admission: create the descriptor and seed it with
+        the longest cached block-aligned prefix of ``tokens``. The caller
+        schedules only the uncached suffix (``seq.seen_tokens`` tokens of
+        the prompt already have live KV). A fully-cached prompt holds the
+        last token back so the suffix forward still emits the first logit
+        row — its write lands in a shared block and copy-on-writes."""
+        seq = self.get_or_create_sequence(uid)
+        if (self._prefix_cache is None or seq.seen_tokens or seq.blocks
+                or len(tokens) <= 1):
+            return seq
+        with telemetry_span("infer/prefix_match", uid=uid, prompt=len(tokens)):
+            blocks, matched = self._prefix_cache.match(tokens)
+        if not blocks:
+            return seq
+        if matched >= len(tokens):
+            matched = len(tokens) - 1
+        seq.extend_blocks(blocks)
+        seq.shared_blocks = len(blocks)
+        seq.seen_tokens = matched
+        seq.token_log = [int(t) for t in tokens[:matched]]
+        self._sync_gauges()
+        return seq
+
+    def ensure_writable(self, seq: DSSequenceDescriptor, start_pos: int,
+                        copy_block_fn: Callable[[int, int], None]) -> None:
+        """Copy-on-write: an imminent KV write starting at flat position
+        ``start_pos`` must not land in a cache-shared block. Each shared
+        block the write reaches is copied into a private block
+        (``copy_block_fn(src, dst)`` does the device page copy) — unless
+        the cache has already evicted its reference, in which case the
+        sequence silently becomes the sole owner."""
+        if seq.shared_blocks == 0:
+            return
+        first = start_pos // self.block_size
+        if first >= seq.shared_blocks:
+            return
+        for idx in range(first, seq.shared_blocks):
+            old = seq.blocks[idx]
+            if self._allocator.refcount(old) == 1:
+                continue  # cache evicted it; already exclusively ours
+            new = self._allocator.allocate(1)[0]
+            copy_block_fn(old, new)
+            self._allocator.release([old])
+            seq.blocks[idx] = new
+            self._m_cow.inc()
+        seq.shared_blocks = first
+        self._sync_gauges()
+
     def allocate_for(self, seq: DSSequenceDescriptor, new_tokens: int) -> None:
         """Grow ``seq``'s block list to cover ``new_tokens`` more KV slots."""
         total = seq.seen_tokens + seq.in_flight_tokens + new_tokens
@@ -100,7 +182,7 @@ class DSStateManager:
             self._sync_gauges()
 
     def can_allocate(self, num_blocks: int) -> bool:
-        return num_blocks <= self._allocator.free_blocks
+        return num_blocks <= self.available_blocks
 
     def block_table_row(self, seq: Optional[DSSequenceDescriptor], width: int,
                         fill_block: int = 0) -> np.ndarray:
@@ -114,16 +196,34 @@ class DSStateManager:
         return row
 
     def flush_sequence(self, uid: int) -> None:
-        """Retire a sequence and return its blocks to the pool."""
+        """Retire a sequence: its block-aligned known prefix is donated to
+        the prefix cache (insert/promote in the radix tree); everything
+        else — partial tail, unknown decode tokens — returns to the pool."""
         seq = self._seqs.pop(uid, None)
         if seq is None:
             logger.debug(f"flush of unknown sequence {uid}")
             return
         if seq.blocks:
-            self._allocator.free(seq.blocks)
+            if self._prefix_cache is not None:
+                n_tok = min(len(seq.token_log), seq.seen_tokens)
+                self._prefix_cache.insert(seq.token_log[:n_tok], seq.blocks)
+            else:
+                self._allocator.free(seq.blocks)
         self._m_flushed.inc()
         self._sync_gauges()
 
     def flush_all(self) -> None:
         for uid in list(self._seqs):
             self.flush_sequence(uid)
+        # re-sync unconditionally: back-to-back SLA runs reset through
+        # here, and an empty tracker must not leave stale gauges behind
+        self._sync_gauges()
+
+    def reset_prefix_cache(self) -> int:
+        """Drop every evictable cached prefix (A/B runs, tests). Returns
+        the number of nodes evicted."""
+        if self._prefix_cache is None:
+            return 0
+        n = self._prefix_cache.clear()
+        self._sync_gauges()
+        return n

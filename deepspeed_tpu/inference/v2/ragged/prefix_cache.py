@@ -1,0 +1,189 @@
+"""Radix prefix cache: block-granular KV reuse across requests.
+
+The blocked-KV design (reference ``inference/v2/ragged/``) makes KV a
+block-granular resource precisely so blocks can be *shared*: at serving
+scale most requests repeat a system prompt or few-shot preamble, and
+re-prefilling it per request is the dominant wasted FLOP and TTFT cost.
+This module keeps the KV blocks of retired prompts alive in a radix tree
+over **block-aligned token prefixes** so the next request that shares
+the prefix skips straight to its uncached suffix.
+
+Structure and invariants:
+
+- one tree node == one *full* KV block, keyed by the tuple of
+  ``block_size`` token ids it covers; a root-to-node path spells a
+  block-aligned prefix and carries the block ids that hold its KV;
+- every node holds one refcount on its block
+  (``BlockedAllocator.retain``); ``match()`` retains matched blocks on
+  behalf of the caller's sequence, so a cached block is freed only when
+  the cache **and** every sequence referencing it let go;
+- cached blocks are immutable — a sequence that must write into a
+  shared block first copies it (copy-on-write, ``DSStateManager
+  .ensure_writable``);
+- under allocation pressure the allocator's eviction hook reclaims
+  least-recently-used **leaves** whose blocks no live sequence shares
+  (refcount 1), down to a free-block watermark, so the cache can never
+  deadlock admission.
+
+Partial blocks are never cached: a tail block's unused slots would be
+written by the reusing sequence, corrupting the donor. ``insert()``
+therefore takes ownership of a retiring sequence's blocks and releases
+everything past the last *fully known* block.
+
+Eviction scans the tree for the LRU leaf (O(nodes) per evicted block);
+pool sizes are a few thousand blocks and eviction is off the dispatch
+hot path, so simplicity wins over an intrusive LRU list.
+"""
+
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from ....telemetry import get_registry as get_telemetry_registry
+from .blocked_allocator import BlockedAllocator
+
+
+class _RadixNode:
+    __slots__ = ("key", "block", "parent", "children", "stamp")
+
+    def __init__(self, key: Optional[Tuple[int, ...]], block: int, parent: Optional["_RadixNode"]):
+        self.key = key        # the block_size token ids this node's block covers
+        self.block = block    # KV block id (-1 at the root)
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_RadixNode"] = {}
+        self.stamp = 0        # LRU clock of the last match/insert touching this node
+
+
+class PrefixCache:
+
+    def __init__(self, allocator: BlockedAllocator, block_size: int, watermark: float = 0.05):
+        self._alloc = allocator
+        self._bs = int(block_size)
+        # eviction drains past the immediate shortfall to this fraction of
+        # the pool, so one pressured allocate doesn't thrash the hook
+        self._watermark_blocks = int(watermark * allocator.total_blocks)
+        self._root = _RadixNode(None, -1, None)
+        self._nodes = 0
+        self._clock = 0
+        tele = get_telemetry_registry()
+        self._m_hits = tele.counter("kv_prefix_hits_total")
+        self._m_hit_tokens = tele.counter("kv_prefix_hit_tokens_total")
+        self._m_evictions = tele.counter("kv_prefix_evictions_total")
+        self._m_cached = tele.gauge("kv_cached_blocks")
+        allocator.set_eviction_hook(self._on_pressure)
+
+    @property
+    def block_size(self) -> int:
+        return self._bs
+
+    @property
+    def cached_blocks(self) -> int:
+        return self._nodes
+
+    def _iter_nodes(self) -> Iterator[_RadixNode]:
+        stack = list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            stack.extend(n.children.values())
+            yield n
+
+    def reclaimable_blocks(self) -> int:
+        """Cached blocks no live sequence shares — what eviction could
+        free right now. Admission accounting treats these as available."""
+        return sum(1 for n in self._iter_nodes() if self._alloc.refcount(n.block) == 1)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # ------------------------------------------------------------- lookup
+    def match(self, tokens: Sequence[int]) -> Tuple[List[int], int]:
+        """Longest cached block-aligned prefix of ``tokens``.
+
+        Returns ``(blocks, n_tokens)``; each returned block has been
+        ``retain``-ed on behalf of the caller's sequence (the caller owns
+        releasing them, normally via ``flush_sequence``).
+        """
+        node, blocks = self._root, []
+        stamp = self._tick()
+        i = 0
+        while i + self._bs <= len(tokens):
+            child = node.children.get(tuple(tokens[i:i + self._bs]))
+            if child is None:
+                break
+            self._alloc.retain(child.block)
+            blocks.append(child.block)
+            child.stamp = stamp
+            node = child
+            i += self._bs
+        if blocks:
+            self._m_hits.inc()
+            self._m_hit_tokens.inc(len(blocks) * self._bs)
+        return blocks, len(blocks) * self._bs
+
+    # ------------------------------------------------------------- insert
+    def insert(self, tokens: Sequence[int], blocks: Sequence[int]) -> int:
+        """Insert/promote a retiring sequence's block-aligned prefix.
+
+        Takes ownership of the sequence's reference on EVERY block in
+        ``blocks``: block ``i`` either becomes the node for
+        ``tokens[i*bs:(i+1)*bs]`` (reference transfers to the cache) or
+        is released (already-cached duplicate, partial tail, or tokens
+        unknown to the host). ``tokens`` is the sequence's host-known
+        token log clipped to its KV coverage. Returns nodes created.
+        """
+        bs = self._bs
+        n_full = min(len(tokens) // bs, len(blocks))
+        node = self._root
+        stamp = self._tick()
+        created = 0
+        for i in range(n_full):
+            key = tuple(tokens[i * bs:(i + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                child = _RadixNode(key, blocks[i], node)
+                node.children[key] = child
+                self._nodes += 1
+                created += 1
+            else:
+                # duplicate prefix (or our own shared block): the cache
+                # already holds a reference — drop the sequence's
+                self._alloc.release([blocks[i]])
+            child.stamp = stamp
+            node = child
+        self._alloc.release(blocks[n_full:])
+        self._m_cached.set(self._nodes)
+        return created
+
+    # ------------------------------------------------------------ eviction
+    def _evict_node(self, node: _RadixNode) -> None:
+        del node.parent.children[node.key]
+        self._nodes -= 1
+        self._alloc.release([node.block])
+        self._m_evictions.inc()
+
+    def evict(self, want_free: int) -> int:
+        """Drop LRU unshared leaves until ``want_free`` blocks are free
+        (or nothing evictable remains). Returns nodes evicted."""
+        evicted = 0
+        while self._alloc.free_blocks < want_free and self._nodes:
+            leaf = None
+            for n in self._iter_nodes():
+                if n.children or self._alloc.refcount(n.block) != 1:
+                    continue  # interior, or shared with a live sequence
+                if leaf is None or n.stamp < leaf.stamp:
+                    leaf = n
+            if leaf is None:
+                break  # every remaining node is interior or live-shared
+            self._evict_node(leaf)
+            evicted += 1
+        if evicted:
+            self._m_cached.set(self._nodes)
+        return evicted
+
+    def _on_pressure(self, shortfall: int) -> None:
+        # allocator eviction hook: free the shortfall plus the watermark
+        self.evict(self._alloc.free_blocks + shortfall + self._watermark_blocks)
+
+    def clear(self) -> int:
+        """Drop every unshared cached block (live-shared nodes survive
+        until their sequences flush). Returns nodes evicted."""
+        return self.evict(self._alloc.total_blocks + self._nodes + 1)

@@ -97,13 +97,16 @@ class RaggedBatchScheduler:
         budget = self.max_batch_tokens
         seqs = 0
         sched_decodes: List[int] = []
-        free = self._state.free_blocks
+        # plan against free + cache-reclaimable blocks: the allocator's
+        # eviction hook reclaims on demand, so cached prefixes never
+        # back-pressure admission into a deadlock
+        free = self._state.available_blocks
 
         for uid in decode_uids:
             seq = self._state.get_sequence(uid)
             if seq is None or budget < 1 or seqs >= self.max_sequences:
                 continue
-            need = seq.blocks_needed(1)
+            need = seq.blocks_needed(1) + seq.cow_blocks_needed(seq.seen_tokens)
             if need > free:
                 continue  # back-pressure: leave it for the next step
             free -= need
@@ -115,12 +118,20 @@ class RaggedBatchScheduler:
         for req in pending_prefills:
             if budget <= 0 or seqs >= self.max_sequences:
                 break
+            seq = self._state.get_sequence(req.uid)
+            if seq is None:
+                # first sight: match the longest cached block-aligned
+                # prefix and trim the request to its uncached suffix —
+                # downstream chunked prefill resumes at seq.seen_tokens
+                seq = self._state.admit_sequence(req.uid, req.tokens)
+                if seq.seen_tokens:
+                    req.tokens = req.tokens[seq.seen_tokens:]
             take = min(req.remaining_prefill, self.prefill_chunk, budget)
             if take <= 0:
                 continue
-            seq = self._state.get_or_create_sequence(req.uid)
             total = seq.seen_tokens + take
-            need = -(-total // bs) - len(seq.blocks)
+            need = (-(-total // bs) - len(seq.blocks)
+                    + seq.cow_blocks_needed(seq.seen_tokens))
             if need > free:
                 break  # FIFO: do not let later requests starve this one
             free -= max(0, need)

@@ -42,6 +42,11 @@ class LoadSpec:
     max_new_tokens: int = 32
     vocab_size: int = 256
     seed: int = 0
+    # shared-system-prompt workload: every prompt starts with the same
+    # shared_prefix_len tokens (drawn once per run) followed by a unique
+    # tail in prompt_len_range — the prefix-cache serving case, where
+    # only the first arrival pays the system prompt's prefill
+    shared_prefix_len: int = 0
 
 
 @dataclasses.dataclass
@@ -74,9 +79,10 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
     arrivals = np.cumsum(rng.exponential(1.0 / spec.arrival_rate, spec.n_requests))
     lo, hi = spec.prompt_len_range
     lens = rng.integers(lo, hi + 1, spec.n_requests)
-    prompts = [rng.integers(0, spec.vocab_size, size=int(l)).tolist() for l in lens]
+    shared = rng.integers(0, spec.vocab_size, size=spec.shared_prefix_len).tolist()
+    prompts = [shared + rng.integers(0, spec.vocab_size, size=int(l)).tolist() for l in lens]
 
-    stats = {i: RequestStat(uid=i, prompt_len=int(lens[i]), arrival=float(arrivals[i]))
+    stats = {i: RequestStat(uid=i, prompt_len=len(prompts[i]), arrival=float(arrivals[i]))
              for i in range(spec.n_requests)}
     reqs: Dict[int, RaggedRequest] = {}
     pending: List[RaggedRequest] = []

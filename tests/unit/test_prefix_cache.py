@@ -1,0 +1,502 @@
+"""Prefix-aware KV reuse: radix prefix cache + refcounted COW blocks.
+
+The contract under test (docs/SERVING.md "Prefix-aware KV reuse"):
+
+- ``BlockedAllocator`` refcounts are exact — no double free, never
+  negative, and every block is either free (refcount 0) or held
+  (refcount > 0), under a randomized op mix (satellite property test);
+- the radix tree matches only block-aligned prefixes, dedupes on
+  insert, evicts LRU unshared leaves under pressure, and never evicts a
+  block a live sequence shares;
+- admission/flush through ``DSStateManager`` trims prompts to the
+  uncached suffix, holds back the last token of a fully-cached prompt,
+  and copy-on-writes before any write into a shared block;
+- with the cache on, greedy outputs are token-for-token identical to
+  the uncached path (fused and unfused), a shared-64-token-prefix
+  workload records ``kv_prefix_hit_tokens_total >= 64`` and dispatches
+  strictly fewer prefill tokens than the uncached engine (the PR's
+  acceptance bar).
+"""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.ragged import BlockedAllocator, PrefixCache
+from deepspeed_tpu.inference.v2.ragged.manager import DSStateManager, RaggedBatchConfig
+from deepspeed_tpu.telemetry import get_registry
+
+
+def _held(alloc):
+    return sum(1 for b in range(alloc.total_blocks) if alloc.refcount(b) > 0)
+
+
+def _assert_pool_invariant(alloc):
+    # every block is free (rc 0) xor held (rc > 0); cached blocks count
+    # as held — "free + cached + live == total" with shared blocks in
+    # both cached and live collapsing to one rc > 0 holder set
+    for b in range(alloc.total_blocks):
+        assert alloc.refcount(b) >= 0, f"negative refcount on block {b}"
+    assert alloc.free_blocks + _held(alloc) == alloc.total_blocks
+
+
+class TestAllocatorRefcounts:
+
+    def test_free_is_release_alias(self):
+        a = BlockedAllocator(4)
+        blocks = a.allocate(2)
+        a.free(blocks)
+        assert a.free_blocks == 4
+
+    def test_double_free_raises(self):
+        a = BlockedAllocator(4)
+        (b,) = a.allocate(1)
+        a.release([b])
+        with pytest.raises(ValueError, match="double free"):
+            a.release([b])
+
+    def test_retain_unallocated_raises(self):
+        a = BlockedAllocator(4)
+        with pytest.raises(ValueError, match="retain"):
+            a.retain(2)
+
+    def test_shared_block_survives_first_release(self):
+        a = BlockedAllocator(4)
+        (b,) = a.allocate(1)
+        a.retain(b)
+        a.release([b])
+        assert a.refcount(b) == 1 and a.free_blocks == 3
+        a.release([b])
+        assert a.free_blocks == 4
+
+    def test_exhaustion_raises(self):
+        a = BlockedAllocator(2)
+        a.allocate(2)
+        with pytest.raises(RuntimeError, match="out of KV blocks"):
+            a.allocate(1)
+
+    def test_eviction_hook_reclaims_shortfall(self):
+        a = BlockedAllocator(4)
+        cached = a.allocate(4)
+        calls = []
+
+        def hook(shortfall):
+            calls.append(shortfall)
+            a.release(cached[:shortfall])
+            del cached[:shortfall]
+
+        a.set_eviction_hook(hook)
+        got = a.allocate(2)
+        assert calls == [2] and len(got) == 2
+        _assert_pool_invariant(a)
+
+    def test_randomized_property(self):
+        """Satellite: randomized alloc/retain/release/evict — no double
+        free, refcounts never negative, free + held == total at every
+        step, and a full drain returns the pool to pristine."""
+        rng = np.random.default_rng(1234)
+        total = 64
+        a = BlockedAllocator(total)
+        model = {}     # block -> expected refcount
+        live = []      # one entry per sequence-held reference
+        cache = set()  # blocks additionally holding one cache reference
+
+        def hook(shortfall):
+            # mimic the prefix cache: drop cache refs until the shortfall
+            # is covered by actually-freed blocks (shared ones don't free)
+            while shortfall > 0 and cache:
+                b = cache.pop()
+                a.release([b])
+                model[b] -= 1
+                if model[b] == 0:
+                    shortfall -= 1
+
+        a.set_eviction_hook(hook)
+        for _ in range(2000):
+            op = rng.integers(0, 4)
+            if op == 0:  # allocate
+                want = int(rng.integers(1, 5))
+                evictable = sum(1 for b in cache if model[b] == 1)
+                if want <= a.free_blocks + evictable:
+                    for b in a.allocate(want):
+                        assert model.get(b, 0) == 0, "allocated a held block"
+                        model[b] = 1
+                        live.append(b)
+                else:
+                    with pytest.raises(RuntimeError):
+                        a.allocate(want)
+            elif op == 1 and live:  # retain: another sequence shares it
+                b = live[int(rng.integers(len(live)))]
+                a.retain(b)
+                model[b] += 1
+                live.append(b)
+            elif op == 2 and live:  # release one sequence reference
+                b = live.pop(int(rng.integers(len(live))))
+                a.release([b])
+                model[b] -= 1
+            elif op == 3 and live:  # hand one reference to the mock cache
+                b = live.pop(int(rng.integers(len(live))))
+                if b in cache:  # cache already holds it: dedupe-release
+                    a.release([b])
+                    model[b] -= 1
+                else:
+                    cache.add(b)
+            for b, rc in model.items():
+                assert a.refcount(b) == rc
+                assert rc >= 0
+            _assert_pool_invariant(a)
+        # drain: releasing every modeled holder returns the whole pool
+        for b in live + sorted(cache):
+            a.release([b])
+        assert a.free_blocks == total
+        (b,) = a.allocate(1)
+        with pytest.raises(ValueError):
+            a.release([b, b])
+
+
+BS = 4
+
+
+def _cache(total=32, watermark=0.0):
+    alloc = BlockedAllocator(total)
+    return alloc, PrefixCache(alloc, BS, watermark=watermark)
+
+
+class TestRadixTree:
+
+    def test_match_empty_and_unaligned(self):
+        _, pc = _cache()
+        assert pc.match(list(range(20))) == ([], 0)
+        # a 3-token prompt can never match: below block granularity
+        assert pc.match([1, 2, 3]) == ([], 0)
+
+    def test_insert_match_roundtrip(self):
+        alloc, pc = _cache()
+        tokens = list(range(10))  # 2 full blocks + 2-token tail
+        blocks = alloc.allocate(3)
+        tail = blocks[2]
+        created = pc.insert(tokens, blocks)
+        assert created == 2 and pc.cached_blocks == 2
+        assert alloc.refcount(tail) == 0  # partial tail released
+        got, n = pc.match(tokens)
+        assert got == blocks[:2] and n == 8
+        assert all(alloc.refcount(b) == 2 for b in got)  # cache + caller
+        alloc.release(got)
+        _assert_pool_invariant(alloc)
+
+    def test_insert_dedupe_releases_duplicates(self):
+        alloc, pc = _cache()
+        tokens = list(range(8))
+        pc.insert(tokens, alloc.allocate(2))
+        free0 = alloc.free_blocks
+        dup = alloc.allocate(2)
+        assert pc.insert(tokens, dup) == 0
+        assert pc.cached_blocks == 2
+        assert alloc.free_blocks == free0  # duplicates went straight back
+
+    def test_divergent_suffixes_share_prefix_nodes(self):
+        alloc, pc = _cache()
+        shared = list(range(4))
+        pc.insert(shared + [10, 11, 12, 13], alloc.allocate(2))
+        created = pc.insert(shared + [20, 21, 22, 23], alloc.allocate(2))
+        assert created == 1  # shared first block deduped
+        assert pc.cached_blocks == 3
+        got_a, _ = pc.match(shared + [10, 11, 12, 13])
+        got_b, _ = pc.match(shared + [20, 21, 22, 23])
+        assert got_a[0] == got_b[0] and got_a[1] != got_b[1]
+        alloc.release(got_a)
+        alloc.release(got_b)
+
+    def test_lru_eviction_order(self):
+        alloc, pc = _cache(total=8)
+        pc.insert([1] * 4, alloc.allocate(1))
+        pc.insert([2] * 4, alloc.allocate(1))
+        old, _ = pc.match([1] * 4)   # re-stamp the older entry
+        alloc.release(old)
+        assert pc.evict(alloc.free_blocks + 1) == 1
+        assert pc.match([2] * 4) == ([], 0)       # LRU victim
+        hit, _ = pc.match([1] * 4)
+        assert len(hit) == 1                       # survivor
+        alloc.release(hit)
+
+    def test_shared_leaves_not_evictable(self):
+        alloc, pc = _cache(total=8)
+        pc.insert([1] * 4, alloc.allocate(1))
+        held, _ = pc.match([1] * 4)  # a live sequence now shares it
+        assert pc.evict(alloc.total_blocks) == 0
+        assert pc.cached_blocks == 1
+        alloc.release(held)
+        assert pc.evict(alloc.total_blocks) == 1
+        _assert_pool_invariant(alloc)
+
+    def test_interior_nodes_evicted_leaf_first(self):
+        alloc, pc = _cache(total=8)
+        pc.insert(list(range(12)), alloc.allocate(3))  # chain of 3
+        assert pc.evict(alloc.free_blocks + 2) == 2    # two deepest leaves
+        got, n = pc.match(list(range(12)))
+        assert n == 4  # root block survived
+        alloc.release(got)
+
+    def test_allocation_pressure_triggers_watermark_eviction(self):
+        alloc = BlockedAllocator(10)
+        pc = PrefixCache(alloc, BS, watermark=0.2)  # watermark: 2 blocks
+        for i in range(10):
+            pc.insert([i] * 4, alloc.allocate(1))
+        assert alloc.free_blocks == 0
+        ev0 = get_registry().counter("kv_prefix_evictions_total").value
+        got = alloc.allocate(1)  # hook evicts shortfall + watermark
+        assert len(got) == 1
+        assert alloc.free_blocks >= 2
+        assert get_registry().counter("kv_prefix_evictions_total").value - ev0 >= 3
+        _assert_pool_invariant(alloc)
+
+    def test_clear_and_cached_gauge(self):
+        alloc, pc = _cache()
+        pc.insert(list(range(8)), alloc.allocate(2))
+        assert get_registry().gauge("kv_cached_blocks").value == 2
+        assert pc.clear() == 2
+        assert pc.cached_blocks == 0
+        assert alloc.free_blocks == alloc.total_blocks
+        assert get_registry().gauge("kv_cached_blocks").value == 0
+
+    def test_randomized_cache_stress(self):
+        """Randomized admit/flush/evict churn against a small pool: the
+        allocator invariant holds throughout and a final drain + clear
+        returns every block."""
+        rng = np.random.default_rng(7)
+        alloc = BlockedAllocator(24)
+        pc = PrefixCache(alloc, BS, watermark=0.1)
+        live = []  # (tokens, blocks) of "running sequences"
+        for _ in range(400):
+            op = rng.integers(0, 3)
+            if op == 0 and len(live) < 6:  # admit: match + allocate suffix
+                tokens = rng.integers(0, 3, size=int(rng.integers(4, 17))).tolist()
+                blocks, matched = pc.match(tokens)
+                need = len(tokens) // BS - len(blocks)
+                try:
+                    blocks = blocks + alloc.allocate(max(0, need))
+                except RuntimeError:
+                    alloc.release(blocks)  # pool truly full of live refs
+                    continue
+                live.append((tokens, blocks))
+            elif op == 1 and live:  # flush: donate prefix to the cache
+                tokens, blocks = live.pop(int(rng.integers(len(live))))
+                pc.insert(tokens, blocks)
+            elif op == 2:
+                pc.evict(int(rng.integers(0, 8)))
+            _assert_pool_invariant(alloc)
+            assert alloc.free_blocks + _held(alloc) == 24
+        for _, blocks in live:
+            alloc.release(blocks)
+        pc.clear()
+        assert alloc.free_blocks == 24
+
+
+def _manager(total=32, bs=BS, enable=True, watermark=0.0):
+    cfg = RaggedBatchConfig(kv_block_size=bs, max_context=1024,
+                            prefix_cache_watermark=watermark)
+    return DSStateManager(cfg, total, enable_prefix_cache=enable)
+
+
+def _prefill(mgr, uid, tokens):
+    """Host-side stand-in for the engine's prefill bookkeeping."""
+    seq = mgr.get_or_create_sequence(uid)
+    suffix = tokens[seq.seen_tokens:]
+    mgr.allocate_for(seq, len(suffix))
+    seq.record_tokens(suffix)
+    seq.seen_tokens += len(suffix)
+    return seq
+
+
+class TestStateManager:
+
+    def test_admit_trims_to_uncached_suffix(self):
+        mgr = _manager()
+        _prefill(mgr, 1, list(range(10)))
+        mgr.flush_sequence(1)  # caches 2 full blocks
+        seq = mgr.admit_sequence(2, list(range(10)) + [99, 98])
+        assert seq.seen_tokens == 8 and seq.shared_blocks == 2
+        assert seq.token_log == list(range(8))
+
+    def test_fully_cached_prompt_holds_back_last_token(self):
+        mgr = _manager()
+        _prefill(mgr, 1, list(range(8)))
+        mgr.flush_sequence(1)
+        seq = mgr.admit_sequence(2, list(range(8)))
+        assert seq.seen_tokens == 7  # at least one token must prefill
+        assert len(seq.blocks) == 2 and seq.shared_blocks == 2
+
+    def test_cow_copies_only_shared_reachable_blocks(self):
+        mgr = _manager()
+        _prefill(mgr, 1, list(range(8)))
+        mgr.flush_sequence(1)
+        seq = mgr.admit_sequence(2, list(range(8)))
+        copies = []
+        copy_fn = lambda src, dst: copies.append((src, dst))
+        cow0 = get_registry().counter("kv_cow_copies_total").value
+        mgr.ensure_writable(seq, 7, copy_fn)  # write into block 1
+        assert len(copies) == 1 and seq.shared_blocks == 1
+        assert get_registry().counter("kv_cow_copies_total").value - cow0 == 1
+        # block 0 still cache-shared; a later write at pos 0 copies it too
+        mgr.ensure_writable(seq, 0, copy_fn)
+        assert len(copies) == 2 and seq.shared_blocks == 0
+        mgr.ensure_writable(seq, 0, copy_fn)  # idempotent
+        assert len(copies) == 2
+
+    def test_decode_log_freeze_caches_prompt_only(self):
+        mgr = _manager()
+        seq = _prefill(mgr, 1, list(range(9)))
+        seq.record_tokens(None)  # deferred decode: ids unknown to host
+        mgr.allocate_for(seq, 4)
+        seq.seen_tokens += 4
+        mgr.flush_sequence(1)
+        # only the prompt's 2 full blocks are cached; decode blocks freed
+        assert mgr.prefix_cache.cached_blocks == 2
+        got, n = mgr.prefix_cache.match(list(range(9)) + [1, 2, 3])
+        assert n == 8
+        mgr.prefix_cache._alloc.release(got)
+
+    def test_available_blocks_counts_reclaimable(self):
+        mgr = _manager(total=8)
+        _prefill(mgr, 1, list(range(8)))
+        mgr.flush_sequence(1)
+        assert mgr.free_blocks == 6
+        assert mgr.available_blocks == 8
+        assert mgr.can_allocate(8)
+
+    def test_no_deadlock_under_cache_pressure(self):
+        # cache holds most of a tiny pool; a new allocation evicts on
+        # demand instead of failing
+        mgr = _manager(total=6)
+        for uid in range(3):
+            _prefill(mgr, uid, [uid * 8 + k for k in range(8)])
+            mgr.flush_sequence(uid)
+        assert mgr.free_blocks == 0 and mgr.available_blocks == 6
+        seq = mgr.get_or_create_sequence(99)
+        mgr.allocate_for(seq, 20)  # needs 5 of 6 blocks
+        assert len(seq.blocks) == 5
+        mgr.flush_sequence(99)
+
+    def test_flush_all_resyncs_gauges(self):
+        mgr = _manager(total=16)
+        _prefill(mgr, 1, list(range(12)))
+        _prefill(mgr, 2, list(range(6)))
+        get_registry().gauge("kv_blocks_free").set(-999)  # go stale
+        mgr.flush_all()
+        assert mgr.n_tracked_sequences == 0
+        assert get_registry().gauge("kv_blocks_free").value == mgr.free_blocks
+        occ = get_registry().gauge("kv_block_occupancy").value
+        assert occ == pytest.approx(1.0 - mgr.free_blocks / 16)
+
+    def test_disabled_cache_frees_on_flush(self):
+        mgr = _manager(enable=False)
+        assert mgr.prefix_cache is None
+        _prefill(mgr, 1, list(range(12)))
+        mgr.flush_sequence(1)
+        assert mgr.free_blocks == mgr.total_blocks
+        seq = mgr.admit_sequence(2, list(range(12)))
+        assert seq.seen_tokens == 0 and seq.shared_blocks == 0
+
+    def test_reset_prefix_cache(self):
+        mgr = _manager()
+        _prefill(mgr, 1, list(range(8)))
+        mgr.flush_sequence(1)
+        assert mgr.reset_prefix_cache() == 2
+        assert mgr.free_blocks == mgr.total_blocks
+
+
+# ---------------------------------------------------------------- engine
+
+@pytest.fixture(scope="module")
+def prefix_setup():
+    import jax
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                            d_model=32, max_seq_len=256, norm="rmsnorm",
+                            activation="swiglu", pos_emb="rope", tie_embeddings=False)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+
+    def engine(cache, fused=True, blocks=128):
+        smc = RaggedBatchConfig(kv_block_size=8, max_context=256, num_kv_blocks=blocks)
+        return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            state_manager=smc, dtype="float32", fused_step=fused,
+            enable_prefix_cache=cache))
+
+    return engine
+
+
+SHARED = [(7 * i + 3) % 128 for i in range(64)]  # 8 full blocks at bs=8
+
+
+class TestEnginePrefixReuse:
+
+    @pytest.mark.parametrize("fused", [True, False], ids=["fused", "unfused"])
+    def test_greedy_parity_cache_on_off(self, prefix_setup, fused):
+        """Token-for-token parity: overlapping-prefix requests, two
+        rounds (second round replays warm-cache admissions)."""
+        engine = prefix_setup
+        prompts = [SHARED[:16] + [99, 98, 97], SHARED[:16] + [55],
+                   SHARED[:24], [1, 2, 3], SHARED[:9] + [0] * 5]
+        on, off = engine(True, fused=fused), engine(False, fused=fused)
+        for _ in range(2):  # round 2 hits the cache populated by round 1
+            assert on.generate(prompts, max_new_tokens=8) == \
+                off.generate(prompts, max_new_tokens=8)
+        assert get_registry().counter("kv_prefix_hit_tokens_total").value > 0
+
+    @pytest.mark.parametrize("fused", [True, False], ids=["fused", "unfused"])
+    def test_shared_64_token_prefix_acceptance(self, prefix_setup, fused):
+        """The PR acceptance bar: a 2-request shared-64-token-prefix
+        workload records >= 64 cache-hit tokens and dispatches strictly
+        fewer prefill tokens than the uncached engine."""
+        engine = prefix_setup
+        p1, p2 = SHARED + [100, 101, 102], SHARED + [110, 111, 112, 113]
+        hits = get_registry().counter("kv_prefix_hit_tokens_total")
+        pf = get_registry().counter("infer_prefill_tokens_total")
+
+        on, off = engine(True, fused=fused), engine(False, fused=fused)
+        out1_on = on.generate([p1], max_new_tokens=6)
+        h0, f0 = hits.value, pf.value
+        out2_on = on.generate([p2], max_new_tokens=6)
+        hit_tokens, prefill_on = hits.value - h0, pf.value - f0
+
+        out1_off = off.generate([p1], max_new_tokens=6)
+        f0 = pf.value
+        out2_off = off.generate([p2], max_new_tokens=6)
+        prefill_off = pf.value - f0
+
+        assert (out1_on, out2_on) == (out1_off, out2_off)
+        assert hit_tokens >= 64
+        assert prefill_on < prefill_off
+
+    def test_fully_cached_prompt_cow_parity(self, prefix_setup):
+        """Replaying an identical block-aligned prompt: the held-back
+        last token's KV write lands in a shared block and must
+        copy-on-write, with output parity against the uncached path."""
+        engine = prefix_setup
+        prompt = SHARED[:16]  # exactly 2 full blocks
+        cow = get_registry().counter("kv_cow_copies_total")
+        on, off = engine(True), engine(False)
+        first = on.generate([prompt], max_new_tokens=5)
+        c0 = cow.value
+        again = on.generate([prompt], max_new_tokens=5)
+        assert cow.value > c0  # the shared tail block was copied
+        assert first == again == off.generate([prompt], max_new_tokens=5)
+
+    def test_blocks_conserved_across_churn(self, prefix_setup):
+        """free + cached == total holds after every generate wave."""
+        engine = prefix_setup
+        eng = engine(True, blocks=64)
+        free0 = eng.state.free_blocks  # engine holds the garbage page
+        rng = np.random.default_rng(5)
+        for wave in range(3):
+            prompts = [SHARED[:int(rng.integers(8, 40))] +
+                       rng.integers(0, 128, size=int(rng.integers(1, 6))).tolist()
+                       for _ in range(4)]
+            eng.generate(prompts, max_new_tokens=4)
+            cached = eng.state.prefix_cache.cached_blocks
+            assert eng.state.free_blocks + cached == free0
+        assert eng.state.reset_prefix_cache() > 0
+        assert eng.state.free_blocks == free0

@@ -70,6 +70,11 @@ class TestRunLoad:
         free0 = eng.state.free_blocks
         run_load(eng, LoadSpec(n_requests=4, arrival_rate=100.0, prompt_len_range=(4, 8),
                                max_new_tokens=4, vocab_size=128, seed=2))
+        # full-block prompts stay cached for prefix reuse; the pool must
+        # account for them and drain completely once the cache lets go
+        cached = eng.state.prefix_cache.cached_blocks if eng.state.prefix_cache else 0
+        assert eng.state.free_blocks + cached == free0
+        eng.state.reset_prefix_cache()
         assert eng.state.free_blocks == free0
 
 
