@@ -45,6 +45,10 @@ import os
 import sys
 import time
 
+# event-log-derived request latency per serve rung ({rung: latency_summary});
+# filled by run_serve/run_serve_prefix, exported by _dump_telemetry
+_EVENT_LATENCY = {}
+
 # ---------------------------------------------------------------------------
 # FROZEN BENCH CONTRACT (BASELINE.md "Frozen rung contract")
 #
@@ -311,15 +315,19 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
     lens = rng.randint(max(4, prompt_len // 2), prompt_len + 1, size=n_prompts)
     prompts = [rng.randint(0, cfg_model.vocab_size, size=(int(l),)).tolist() for l in lens]
     eng.generate(prompts, max_new_tokens=new_tokens)  # compile every bucket/burst shape
-    from deepspeed_tpu.telemetry import get_registry
+    from deepspeed_tpu.telemetry import get_event_log, get_registry, latency_summary
     reg = get_registry()
     disp = reg.counter("infer_dispatches_total")
     hits = reg.counter("kv_prefix_hits_total")
     hit_toks = reg.counter("kv_prefix_hit_tokens_total")
     d0, h0, ht0 = disp.value, hits.value, hit_toks.value
+    events = get_event_log()
+    events.clear()  # only the timed run's request timelines count
     t0 = time.perf_counter()
     out = eng.generate(prompts, max_new_tokens=new_tokens)
     dt = time.perf_counter() - t0
+    lat = latency_summary(events.events())
+    _EVENT_LATENCY["serve"] = lat
     assert all(len(o) == new_tokens for o in out)
     served = n_prompts * new_tokens
     prompt_toks = sum(len(p) for p in prompts)
@@ -333,7 +341,10 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
                          "tokens_per_dispatch": round(served / max(1, disp.value - d0), 2),
                          "fused": eng._fused_enabled,
                          "prefix_hit_rate": round((hits.value - h0) / n_prompts, 4),
-                         "cached_token_fraction": round((hit_toks.value - ht0) / max(1, prompt_toks), 4)}
+                         "cached_token_fraction": round((hit_toks.value - ht0) / max(1, prompt_toks), 4),
+                         "ttft_p50_s": lat["ttft_p50_s"], "ttft_p99_s": lat["ttft_p99_s"],
+                         "tpot_p50_s": lat["tpot_p50_s"], "tpot_p99_s": lat["tpot_p99_s"],
+                         "queue_time_fraction": lat["queue_time_fraction"]}
 
 
 def run_serve_prefix(jax, jnp, np, cfg_model, platform):
@@ -376,9 +387,14 @@ def run_serve_prefix(jax, jnp, np, cfg_model, platform):
     pre_toks = reg.counter("infer_prefill_tokens_total")
     warm = wave()
     h0, ht0, p0 = hits.value, hit_toks.value, pre_toks.value
+    from deepspeed_tpu.telemetry import get_event_log, latency_summary
+    events = get_event_log()
+    events.clear()  # only the warm wave's request timelines count
     t0 = time.perf_counter()
     out = eng.generate(warm, max_new_tokens=new_toks)
     dt = time.perf_counter() - t0
+    lat = latency_summary(events.events())
+    _EVENT_LATENCY["serve_prefix"] = lat
     assert all(len(o) == new_toks for o in out)
     served = n_req * new_toks
     prompt_toks = sum(len(p) for p in warm)
@@ -390,6 +406,9 @@ def run_serve_prefix(jax, jnp, np, cfg_model, platform):
         "prefill_tokens": int(pre_toks.value - p0),  # dispatched; < prompt_tokens when warm
         "prompt_tokens": prompt_toks,
         "cached_blocks": eng.state.prefix_cache.cached_blocks,
+        "ttft_p50_s": lat["ttft_p50_s"], "ttft_p99_s": lat["ttft_p99_s"],
+        "tpot_p50_s": lat["tpot_p50_s"], "tpot_p99_s": lat["tpot_p99_s"],
+        "queue_time_fraction": lat["queue_time_fraction"],
     }
 
 
@@ -706,6 +725,10 @@ def _dump_telemetry(rung):
 
         snap = get_registry().snapshot()
         snap["rung"] = rung
+        if _EVENT_LATENCY:
+            # true per-request percentiles reconstructed from the event
+            # log's request timelines (docs/OBSERVABILITY.md "Event log")
+            snap["request_latency"] = _EVENT_LATENCY
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_TELEMETRY.json")
         with open(path, "w") as f:
             json.dump(snap, f, indent=1, sort_keys=True)

@@ -27,6 +27,9 @@ import numpy as np
 from ...models.transformer import TransformerConfig
 from ...telemetry import get_registry as get_telemetry_registry
 from ...telemetry import span as telemetry_span
+from ...telemetry.events import get_event_log
+from ...telemetry.health import (QueueStallDetector, SLOBurnRateDetector,
+                                 get_health_monitor)
 from ...utils.logging import log_dist, logger
 from .model_runner import make_burst_fn, make_fused_step_fn, make_step_fns
 from .ragged.manager import DSStateManager, RaggedBatchConfig
@@ -148,6 +151,11 @@ class InferenceEngineV2:
         self._m_dispatches = tele.counter("infer_dispatches_total")
         self._m_fused_quanta = tele.counter("infer_fused_quanta_total")
         self._m_fused_fill = tele.gauge("infer_fused_batch_fill")
+        # request-lifecycle event log + serving health detectors
+        self._events = get_event_log()
+        self._health = get_health_monitor()
+        self._health.ensure_detector(QueueStallDetector())
+        self._health.ensure_detector(SLOBurnRateDetector())
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -475,6 +483,10 @@ class InferenceEngineV2:
         self._m_decode_steps.inc()
         self._m_decode_tokens.inc(n)
         self._m_decode_fill.set(n / len(ctx))
+        if self._events.enabled:
+            q = self.scheduler.last_quantum_id
+            for uid in uids:
+                self._events.emit("decode", uid, q=q, k=1)
         for seq in seqs:
             seq.post_forward()
         if defer:
@@ -519,6 +531,11 @@ class InferenceEngineV2:
         self._m_decode_steps.inc(steps)
         self._m_decode_tokens.inc(n * steps)
         self._m_decode_fill.set(n / len(ctx))
+        if self._events.enabled:
+            # out-of-band burst: claims its own quantum id (no schedule call)
+            q = self.scheduler.next_quantum()
+            for uid in uids:
+                self._events.emit("decode", uid, q=q, k=steps)
         for seq in seqs:
             seq.post_forward()
         if defer:
@@ -682,6 +699,10 @@ class InferenceEngineV2:
         self._m_fused_quanta.inc()
         real = n_dec * steps + sum(len(p.tokens) for p in prefills)
         self._m_fused_fill.set(real / max(1, D * steps + P * S))
+        if self._events.enabled and dec_uids:
+            q = self.scheduler.last_quantum_id
+            for uid in dec_uids:
+                self._events.emit("decode", uid, q=q, k=steps)
         if n_dec:
             self._m_decode_steps.inc(steps)
             self._m_decode_tokens.inc(n_dec * steps)
@@ -725,6 +746,9 @@ class InferenceEngineV2:
         self._sampling = (True, float(temperature), int(top_k), float(top_p)) if do_sample else None
         self._rng = jax.random.PRNGKey(seed)
         self._m_requests.inc(len(prompts))
+        if self._events.enabled:
+            for i, p in enumerate(prompts):
+                self._events.emit("enqueue", i, prompt=len(p))
         try:
             return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
         finally:
@@ -732,6 +756,7 @@ class InferenceEngineV2:
 
     def _commit_closures(self, reqs, results, pieces, counts, decode_ready, eos_token_id, on_token):
         """(commit, commit_dev) shared by the fused and unfused loops."""
+        events = self._events
 
         def commit(uid: int, toks_out: List[int]) -> None:
             """Record sampled tokens and retire/continue the request."""
@@ -742,11 +767,15 @@ class InferenceEngineV2:
                 budget = req.max_new_tokens - len(results[uid])
                 for tok in toks_out[:budget]:
                     on_token(uid, tok)
+            first = not results[uid]
             results[uid].extend(toks_out)
+            if first:
+                events.emit("first_token", uid)
             done = (len(results[uid]) >= req.max_new_tokens or
                     (eos_token_id is not None and toks_out[-1] == eos_token_id))
             if done:
                 req.done = True
+                events.emit("finish", uid, n_new=len(results[uid]))
                 self.flush([uid])
             else:
                 decode_ready[uid] = toks_out[-1]
@@ -756,9 +785,13 @@ class InferenceEngineV2:
             req = reqs[uid]
             row = jnp.atleast_1d(row)
             pieces[uid].append(row)
+            first = counts[uid] == 0
             counts[uid] += int(row.shape[0])
+            if first:
+                events.emit("first_token", uid)
             if counts[uid] >= req.max_new_tokens:
                 req.done = True
+                events.emit("finish", uid, n_new=counts[uid])
                 self.flush([uid])
             else:
                 decode_ready[uid] = row[-1]
@@ -803,6 +836,7 @@ class InferenceEngineV2:
                                                    eos_token_id, on_token)
 
         while pending or decode_ready:
+            self._health.poll()
             quantum = self.scheduler.schedule_fused([r for r in pending if r.remaining_prefill],
                                                     list(decode_ready))
             if quantum.empty:
@@ -851,6 +885,7 @@ class InferenceEngineV2:
                                                    eos_token_id, on_token)
 
         while pending or decode_ready:
+            self._health.poll()
             # Burst path: nothing left to admit and everyone is decoding —
             # run K fused steps on-device instead of K host roundtrips.
             # A sequence that hits EOS mid-burst wastes its tail steps

@@ -22,6 +22,7 @@ import numpy as np
 
 from ....telemetry import get_registry as get_telemetry_registry
 from ....telemetry import span as telemetry_span
+from ....telemetry.events import get_event_log
 from ....utils.logging import logger
 from .blocked_allocator import BlockedAllocator
 from .prefix_cache import PrefixCache
@@ -64,6 +65,7 @@ class DSStateManager:
         self._m_flushed = tele.counter("kv_sequences_flushed_total")
         self._m_cow = tele.counter("kv_cow_copies_total")
         tele.gauge("kv_blocks_total").set(num_kv_blocks)
+        self._events = get_event_log()
         self._sync_gauges()
 
     def _sync_gauges(self) -> None:
@@ -131,18 +133,21 @@ class DSStateManager:
         seq = self.get_or_create_sequence(uid)
         if (self._prefix_cache is None or seq.seen_tokens or seq.blocks
                 or len(tokens) <= 1):
+            self._events.emit("admit", uid, hit=seq.seen_tokens,
+                              prompt=len(tokens))
             return seq
         with telemetry_span("infer/prefix_match", uid=uid, prompt=len(tokens)):
             blocks, matched = self._prefix_cache.match(tokens)
-        if not blocks:
-            return seq
-        if matched >= len(tokens):
-            matched = len(tokens) - 1
-        seq.extend_blocks(blocks)
-        seq.shared_blocks = len(blocks)
-        seq.seen_tokens = matched
-        seq.token_log = [int(t) for t in tokens[:matched]]
-        self._sync_gauges()
+        if blocks:
+            if matched >= len(tokens):
+                matched = len(tokens) - 1
+            seq.extend_blocks(blocks)
+            seq.shared_blocks = len(blocks)
+            seq.seen_tokens = matched
+            seq.token_log = [int(t) for t in tokens[:matched]]
+            self._sync_gauges()
+        self._events.emit("admit", uid, hit=seq.seen_tokens,
+                          prompt=len(tokens))
         return seq
 
     def ensure_writable(self, seq: DSSequenceDescriptor, start_pos: int,
@@ -158,6 +163,7 @@ class DSStateManager:
         first = start_pos // self.block_size
         if first >= seq.shared_blocks:
             return
+        copied = 0
         for idx in range(first, seq.shared_blocks):
             old = seq.blocks[idx]
             if self._allocator.refcount(old) == 1:
@@ -167,6 +173,9 @@ class DSStateManager:
             self._allocator.release([old])
             seq.blocks[idx] = new
             self._m_cow.inc()
+            copied += 1
+        if copied:
+            self._events.emit("cow", seq.uid, blocks=copied)
         seq.shared_blocks = first
         self._sync_gauges()
 

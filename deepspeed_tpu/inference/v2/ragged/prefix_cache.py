@@ -38,6 +38,7 @@ hot path, so simplicity wins over an intrusive LRU list.
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 from ....telemetry import get_registry as get_telemetry_registry
+from ....telemetry.events import get_event_log
 from .blocked_allocator import BlockedAllocator
 
 
@@ -68,6 +69,7 @@ class PrefixCache:
         self._m_hit_tokens = tele.counter("kv_prefix_hit_tokens_total")
         self._m_evictions = tele.counter("kv_prefix_evictions_total")
         self._m_cached = tele.gauge("kv_cached_blocks")
+        self._events = get_event_log()
         allocator.set_eviction_hook(self._on_pressure)
 
     @property
@@ -177,6 +179,7 @@ class PrefixCache:
             evicted += 1
         if evicted:
             self._m_cached.set(self._nodes)
+            self._events.emit("evict", blocks=evicted)
         return evicted
 
     def _on_pressure(self, shortfall: int) -> None:

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import List, Optional, Tuple
 
 from ...telemetry import get_registry as get_telemetry_registry
+from ...telemetry.events import get_event_log
 from .ragged.manager import DSStateManager
 
 
@@ -85,6 +86,20 @@ class RaggedBatchScheduler:
         self._m_decodes = tele.counter("sched_decodes_total")
         self._m_prefill_chunks = tele.counter("sched_prefill_chunks_total")
         self._m_quantum_rows = tele.gauge("sched_quantum_rows")
+        self._events = get_event_log()
+        self._quantum_seq = 0  # monotone id shared by fused and unfused paths
+
+    @property
+    def last_quantum_id(self) -> int:
+        """Id of the most recently assembled quantum — the engine tags
+        decode events from that quantum's dispatch with it."""
+        return self._quantum_seq
+
+    def next_quantum(self) -> int:
+        """Claim a fresh quantum id (the engine's out-of-band decode
+        bursts bypass ``schedule`` and still need distinct ids)."""
+        self._quantum_seq += 1
+        return self._quantum_seq
 
     def schedule(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> ScheduledStep:
         """Pick the work for one engine step.
@@ -96,6 +111,7 @@ class RaggedBatchScheduler:
         bs = self._state.block_size
         budget = self.max_batch_tokens
         seqs = 0
+        q = self.next_quantum()
         sched_decodes: List[int] = []
         # plan against free + cache-reclaimable blocks: the allocator's
         # eviction hook reclaims on demand, so cached prefixes never
@@ -137,13 +153,20 @@ class RaggedBatchScheduler:
             free -= max(0, need)
             budget -= take
             seqs += 1
+            final = take == req.remaining_prefill
             prefills.append(ScheduledPrefill(uid=req.uid, tokens=req.tokens[:take], start_pos=seq.seen_tokens,
-                                             final=take == req.remaining_prefill))
+                                             final=final))
+            self._events.emit("prefill_chunk", req.uid, q=q, tokens=take,
+                              start=seq.seen_tokens, final=final)
 
         self._m_queue_depth.set(len(pending_prefills))
         self._m_step_tokens.set(self.max_batch_tokens - budget)
         self._m_decodes.inc(len(sched_decodes))
         self._m_prefill_chunks.inc(len(prefills))
+        if prefills or sched_decodes:
+            self._events.emit("quantum", q=q, prefills=len(prefills),
+                              decodes=len(sched_decodes),
+                              tokens=self.max_batch_tokens - budget)
         return ScheduledStep(prefills=prefills, decode_uids=sched_decodes)
 
     def schedule_fused(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> FusedQuantum:

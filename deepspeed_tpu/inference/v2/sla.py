@@ -24,6 +24,9 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ...telemetry import get_registry as get_telemetry_registry
+from ...telemetry.events import get_event_log
+from ...telemetry.health import (QueueStallDetector, SLOBurnRateDetector,
+                                 get_health_monitor)
 from .scheduler import RaggedRequest
 
 # SLA-shaped buckets: the FastGen streaming SLA (TTFT <= 1 s,
@@ -93,6 +96,10 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
     tele = get_telemetry_registry()
     h_ttft = tele.histogram("infer_ttft_seconds", buckets=TTFT_BUCKETS)
     h_tpot = tele.histogram("infer_tpot_seconds", buckets=TPOT_BUCKETS)
+    events = get_event_log()
+    health = get_health_monitor()
+    health.ensure_detector(QueueStallDetector())
+    health.ensure_detector(SLOBurnRateDetector())
 
     t0 = time.perf_counter()
 
@@ -109,6 +116,10 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             stats[uid].admitted = t
             results[uid] = []
             pending.append(reqs[uid])
+            # stamped with the SCHEDULED arrival: event-derived TTFT then
+            # equals the harness's (first_token - arrival) exactly
+            events.emit("enqueue", uid, ts=t0 + float(arrivals[uid]),
+                        prompt=len(prompts[uid]))
             next_idx += 1
 
     def commit(uid: int, toks_out: List[int]) -> None:
@@ -119,6 +130,7 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
         if not results[uid]:
             stats[uid].first_token = t
             h_ttft.observe(t - stats[uid].arrival)
+            events.emit("first_token", uid, ts=t0 + t)
         results[uid].extend(toks_out)
         stats[uid].n_new = len(results[uid])
         finished = (len(results[uid]) >= req.max_new_tokens or
@@ -128,6 +140,8 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             stats[uid].done = t
             if stats[uid].n_new > 1:
                 h_tpot.observe(stats[uid].tpot)
+            events.emit("finish", uid, ts=t0 + t, n_new=stats[uid].n_new)
+            health.observe_request(ttft_s=stats[uid].ttft, tpot_s=stats[uid].tpot)
             engine.flush([uid])
         else:
             decode_ready[uid] = toks_out[-1]
@@ -136,6 +150,7 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
 
     while next_idx < spec.n_requests or pending or decode_ready:
         admit_arrivals()
+        health.poll()
         if not pending and not decode_ready:
             # idle: sleep to the next arrival (open-loop source)
             time.sleep(max(0.0, arrivals[next_idx] - now()))

@@ -36,6 +36,8 @@ from ..parallel.mesh import MeshTopology, get_mesh_topology, initialize_mesh
 from ..telemetry import MonitorBridge
 from ..telemetry import get_registry as get_telemetry_registry
 from ..telemetry import span as telemetry_span
+from ..telemetry.health import (GradNormSpikeDetector, NonFiniteLossDetector,
+                                get_health_monitor)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, STEP_GLOBAL_TIMER, NoopTimer,
                            SynchronizedWallClockTimer, ThroughputTimer, TRAIN_BATCH_TIMER)
@@ -267,6 +269,11 @@ class DeepSpeedEngine:
         self._monitor_bridge = MonitorBridge(
             tele, self.monitor,
             every_n_steps=int(os.environ.get("DS_TPU_TELEMETRY_FLUSH_STEPS", "1")))
+        # health sentinels observe at the SAME host-sync points as the
+        # gauges above — anomaly detection never adds a device readback
+        self.health = get_health_monitor()
+        self.health.ensure_detector(NonFiniteLossDetector())
+        self.health.ensure_detector(GradNormSpikeDetector())
 
         # legacy curriculum learning (reference engine.py:1821-1833): the
         # scheduler's difficulty is a sequence length; forward() truncates
@@ -736,6 +743,7 @@ class DeepSpeedEngine:
             if self._last_loss is not None:
                 loss_host = float(self._last_loss)
                 self._m_loss.set(loss_host)
+                self.health.observe_loss(loss_host)
                 extra.append(("Train/Samples/train_loss", loss_host, self.global_samples))
             self._monitor_bridge.maybe_flush(self.global_steps, extra_events=extra)
 
@@ -787,8 +795,11 @@ class DeepSpeedEngine:
         # without a per-step readback
         skipped = self.skipped_steps
         self._m_loss.set(loss)
+        if self._last_loss is not None:
+            self.health.observe_loss(loss)
         if self._global_grad_norm is not None:
             self._m_gnorm.set(float(self._global_grad_norm))
+            self.health.observe_grad_norm(float(self._global_grad_norm))
         skip_note = f" skipped={skipped}" if skipped else ""
         log_dist(
             f"step={self.global_steps} loss={loss:.4f} lr={lr:.3e} "

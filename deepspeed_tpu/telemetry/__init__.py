@@ -1,24 +1,41 @@
-"""Unified telemetry: metrics registry, span tracer, monitor bridge.
+"""Unified telemetry: metrics registry, span tracer, event log, health
+monitor, monitor bridge.
 
 See docs/OBSERVABILITY.md for the metric catalog, span naming
-convention, and overhead guarantees. Env knobs: ``DS_TPU_TELEMETRY=0``
-disables both registry and tracer at startup; ``set_enabled()`` flips
-them at runtime.
+convention, event schema, and overhead guarantees. Env knobs:
+``DS_TPU_TELEMETRY=0`` disables registry, tracer and event log at
+startup; ``set_enabled()`` flips them at runtime.
 """
 
 from .registry import (DEFAULT_BUCKETS, Counter, Gauge, Histogram,
                        MetricsRegistry, get_registry)
 from .tracing import SpanTracer, dump_trace, get_tracer, span
 from .bridge import MonitorBridge
+from .events import (EventLog, get_event_log, latency_summary,
+                     lifecycle_signature, request_metrics,
+                     request_timelines, validate_timeline)
+from .health import (Alert, CallbackAlertSink, Detector,
+                     GradNormSpikeDetector, HealthMonitor, JsonlAlertSink,
+                     LoggerAlertSink, NonFiniteLossDetector,
+                     QueueStallDetector, SLOBurnRateDetector,
+                     get_health_monitor)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
     "get_registry", "SpanTracer", "get_tracer", "span", "dump_trace",
     "MonitorBridge", "set_enabled",
+    "EventLog", "get_event_log", "request_timelines", "request_metrics",
+    "latency_summary", "lifecycle_signature", "validate_timeline",
+    "Alert", "Detector", "HealthMonitor", "get_health_monitor",
+    "NonFiniteLossDetector", "GradNormSpikeDetector", "QueueStallDetector",
+    "SLOBurnRateDetector", "LoggerAlertSink", "JsonlAlertSink",
+    "CallbackAlertSink",
 ]
 
 
 def set_enabled(flag: bool) -> None:
-    """Enable/disable metric recording and span tracing process-wide."""
+    """Enable/disable metric recording, span tracing and event emission
+    process-wide."""
     get_registry().enabled = bool(flag)
     get_tracer().enabled = bool(flag)
+    get_event_log().enabled = bool(flag)

@@ -74,8 +74,11 @@ class _ActiveSpan:
         t1 = time.perf_counter()
         _TLS.depth = self.depth
         tr = self._tracer
-        tr._ring.append((self.name, self.t0, t1 - self.t0,
-                         threading.get_ident(), self.depth, self.attrs))
+        ring = tr._ring
+        if len(ring) == ring.maxlen:
+            tr._m_dropped.inc()  # oldest span about to fall off the ring
+        ring.append((self.name, self.t0, t1 - self.t0,
+                     threading.get_ident(), self.depth, self.attrs))
         if tr.annotate_xla:
             tr._range_pop()
         return False
@@ -86,11 +89,15 @@ class SpanTracer:
     ``get_tracer()``; direct construction is for tests."""
 
     def __init__(self, capacity: int = 4096, enabled: bool = True,
-                 annotate_xla: bool = False):
+                 annotate_xla: bool = False, registry=None):
         self.enabled = enabled
         self.annotate_xla = annotate_xla
         self._ring = deque(maxlen=max(1, int(capacity)))
         self._acc = None
+        if registry is None:
+            from .registry import get_registry
+            registry = get_registry()
+        self._m_dropped = registry.counter("telemetry_spans_dropped_total")
 
     def span(self, name: str, blocking: bool = False, **attrs):
         if not self.enabled:

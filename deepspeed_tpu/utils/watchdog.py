@@ -13,6 +13,7 @@ makes a wedged tunnel distinguishable from a merely slow step.
 
 import os
 import threading
+import time
 from typing import Any, Callable, Optional, Tuple
 
 DEFAULT_TIMEOUT_S = 180.0
@@ -46,9 +47,22 @@ def run_with_watchdog(fn: Callable[[], Any], timeout_s: Optional[float] = None) 
         except BaseException as e:  # noqa: BLE001 - surfaced to the caller
             box["error"] = e
 
+    from ..telemetry.health import get_health_monitor
+
+    monitor = get_health_monitor()
     t = threading.Thread(target=run, daemon=True)
     t.start()
-    t.join(timeout_s)
+    # join in slices so clock-driven detectors (queue stall) can raise a
+    # structured alert BEFORE the bare deadline fires — a scheduler that
+    # admits nothing while requests wait trips DS_TPU_STALL_S first
+    deadline = time.monotonic() + timeout_s
+    while t.is_alive():
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        t.join(min(1.0, remaining))
+        if t.is_alive():
+            monitor.poll()
     if "error" in box:
         return "error", box["error"]
     if "value" in box:
@@ -56,4 +70,12 @@ def run_with_watchdog(fn: Callable[[], Any], timeout_s: Optional[float] = None) 
     from ..telemetry.registry import get_registry
 
     get_registry().counter("watchdog_timeouts_total").inc()
+    attrs = {"timeout_s": float(timeout_s)}
+    stall = monitor.detector("queue_stall")
+    if stall is not None and getattr(stall, "waiting", None):
+        attrs["pending_requests"] = len(stall.waiting)
+        attrs["stalled_s"] = round(stall.stalled_for(), 3)
+    monitor.raise_alert("watchdog_timeout",
+                        f"watchdog: call exceeded {timeout_s:.0f}s deadline",
+                        **attrs)
     return "timeout", None

@@ -418,3 +418,355 @@ def test_engine_train_step_telemetry(tmp_path):
     tracer.dump_trace(trace_path)
     assert any(e["name"] == "train/step" for e in
                json.loads(trace_path.read_text())["traceEvents"])
+
+
+# ------------------------------------------------------------- span drops
+
+def test_span_ring_drop_counter():
+    """Evicting a span off the trace ring counts into
+    telemetry_spans_dropped_total (docs/OBSERVABILITY.md catalog)."""
+    reg = MetricsRegistry()
+    tracer = SpanTracer(capacity=2, registry=reg)
+    for i in range(5):
+        with tracer.span(f"s{i}"):
+            pass
+    assert len(tracer.spans()) == 2
+    assert reg.peek("telemetry_spans_dropped_total") == 3
+
+
+# -------------------------------------------------------------- event log
+
+def _mk_event_log(capacity=64):
+    from deepspeed_tpu.telemetry import EventLog
+    reg = MetricsRegistry()
+    return EventLog(capacity=capacity, registry=reg), reg
+
+
+def test_event_log_ring_bounds_and_counters():
+    ev, reg = _mk_event_log(capacity=4)
+    for i in range(6):
+        ev.emit("decode", i, k=1)
+    assert len(ev) == 4
+    assert [e["uid"] for e in ev.events()] == [2, 3, 4, 5]  # oldest evicted
+    assert reg.peek("telemetry_events_total") == 6
+    assert reg.peek("telemetry_events_dropped_total") == 2
+
+
+def test_event_log_disabled_records_nothing():
+    ev, reg = _mk_event_log()
+    ev.enabled = False
+    ev.emit("enqueue", 1)
+    assert len(ev) == 0 and reg.peek("telemetry_events_total") == 0
+
+
+def test_event_log_filters_and_explicit_ts():
+    ev, _ = _mk_event_log()
+    ev.emit("enqueue", 7, ts=1.25, prompt=4)
+    ev.emit("admit", 7, ts=1.5, hit=0)
+    ev.emit("enqueue", 8, ts=2.0)
+    assert [e["kind"] for e in ev.events(uid=7)] == ["enqueue", "admit"]
+    assert [e["uid"] for e in ev.events(kind="enqueue")] == [7, 8]
+    assert ev.events(uid=7)[0]["ts"] == 1.25  # explicit ts wins over the clock
+
+
+def test_event_log_jsonl_sink(tmp_path):
+    ev, _ = _mk_event_log()
+    path = tmp_path / "events.jsonl"
+    ev.open_sink(str(path))
+    for i in range(10):
+        ev.emit("decode", i, k=2)
+    ev.close_sink()
+    lines = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [e["uid"] for e in lines] == list(range(10))
+    assert all(e["kind"] == "decode" and e["k"] == 2 for e in lines)
+
+
+def test_event_log_listener_and_exception_isolation():
+    ev, _ = _mk_event_log()
+    got = []
+    ev.add_listener(lambda ts, kind, uid, attrs: got.append((kind, uid, attrs)))
+    ev.add_listener(lambda *a: 1 / 0)  # broken listener must be swallowed
+    ev.emit("admit", 3, hit=8)
+    assert got == [("admit", 3, {"hit": 8})]
+
+
+# ------------------------------------------------------ timeline derivation
+
+def _synthetic_request(uid, t0, hit=0, chunks=(4,), n_new=3, k_per_decode=1):
+    """One well-formed lifecycle as raw event dicts."""
+    evs = [{"ts": t0, "kind": "enqueue", "uid": uid, "prompt": sum(chunks)}]
+    t = t0 + 0.01
+    evs.append({"ts": t, "kind": "admit", "uid": uid, "hit": hit})
+    for c in chunks:
+        t += 0.01
+        evs.append({"ts": t, "kind": "prefill_chunk", "uid": uid, "q": 1, "tokens": c})
+    t += 0.01
+    evs.append({"ts": t, "kind": "first_token", "uid": uid})
+    for _ in range((n_new - 1) // k_per_decode):
+        t += 0.01
+        evs.append({"ts": t, "kind": "decode", "uid": uid, "q": 2, "k": k_per_decode})
+    t += 0.01
+    evs.append({"ts": t, "kind": "finish", "uid": uid, "n_new": n_new})
+    return evs
+
+
+def test_request_timelines_uid_reuse_and_orphans():
+    from deepspeed_tpu.telemetry import request_timelines
+    evs = _synthetic_request(0, 1.0) + _synthetic_request(0, 2.0)
+    evs.append({"ts": 3.0, "kind": "decode", "uid": 99, "k": 1})  # no enqueue: orphan
+    evs.append({"ts": 3.0, "kind": "evict", "uid": -1, "blocks": 2})  # global record
+    tls = request_timelines(evs)
+    assert set(tls) == {0} and len(tls[0]) == 2  # one timeline per enqueue
+    from deepspeed_tpu.telemetry import validate_timeline
+    assert validate_timeline(tls[0][0]) == [] and validate_timeline(tls[0][1]) == []
+
+
+def test_validate_timeline_catches_malformations():
+    from deepspeed_tpu.telemetry import validate_timeline
+    good = _synthetic_request(1, 0.0)
+    assert validate_timeline(good) == []
+    assert "missing 'finish'" in validate_timeline(good[:-1])[0]
+    bad_order = [good[0], good[3], good[1]]  # admit after first_token, ts regression
+    assert any("regression" in p for p in validate_timeline(bad_order))
+    no_enq = good[1:]
+    assert any("enqueue" in p for p in validate_timeline(no_enq))
+
+
+def test_lifecycle_signature_merges_bursts():
+    """A fused 4-token burst and 4 single decode steps must produce the
+    SAME signature — the fused/unfused parity invariant rides on this."""
+    from deepspeed_tpu.telemetry import lifecycle_signature
+    single = _synthetic_request(0, 0.0, chunks=(4,), n_new=5, k_per_decode=1)
+    burst = _synthetic_request(0, 9.0, chunks=(4,), n_new=5, k_per_decode=4)
+    sig = lifecycle_signature(single)
+    assert sig == lifecycle_signature(burst)
+    assert sig == (("enqueue",), ("admit", 0), ("prefill_chunk", 4),
+                   ("first_token",), ("decode", 4), ("finish",))
+
+
+def test_request_metrics_and_latency_summary():
+    from deepspeed_tpu.telemetry import latency_summary, request_metrics
+    tl = _synthetic_request(5, 10.0, chunks=(4, 4), n_new=3)
+    m = request_metrics(tl)
+    assert m["queue_s"] == pytest.approx(0.01)
+    assert m["ttft_s"] == pytest.approx(0.04)
+    assert m["prefill_s"] == pytest.approx(0.03)
+    assert m["decode_s"] == pytest.approx(0.03)
+    assert m["tpot_s"] == pytest.approx(0.015)
+    assert m["total_s"] == pytest.approx(m["queue_s"] + m["prefill_s"] + m["decode_s"])
+    assert request_metrics(tl[:-1]) is None  # incomplete -> None, not garbage
+    evs = _synthetic_request(0, 0.0) + _synthetic_request(1, 0.5) + [
+        {"ts": 9.0, "kind": "enqueue", "uid": 2, "prompt": 4}]  # never finishes
+    s = latency_summary(evs)
+    assert s["n_requests"] == 3.0 and s["n_complete"] == 2.0
+    assert s["ttft_p50_s"] == pytest.approx(0.03)  # single-chunk requests: first at t0+0.03
+    assert 0.0 < s["queue_time_fraction"] < 1.0
+
+
+# --------------------------------------------------------------- detectors
+
+def test_nonfinite_loss_detector_latch_and_cooldown():
+    from deepspeed_tpu.telemetry import NonFiniteLossDetector
+    d = NonFiniteLossDetector(cooldown_s=3600.0)
+    assert d.observe(1.0) is None
+    alert = d.observe(float("nan"))
+    assert alert is not None and alert.detector == "nan_loss"
+    # latched: persistent NaN raises exactly one alert
+    assert all(d.observe(float("nan")) is None for _ in range(50))
+    # a finite loss re-arms, but cooldown suppresses an immediate refire
+    assert d.observe(2.0) is None
+    assert d.observe(float("inf")) is None  # within cooldown
+    d.reset()
+    assert d.observe(float("inf")) is not None  # reset clears the cooldown
+
+
+def test_nonfinite_loss_detector_zero_cooldown_refires():
+    from deepspeed_tpu.telemetry import NonFiniteLossDetector
+    d = NonFiniteLossDetector(cooldown_s=0.0)
+    assert d.observe(float("nan")) is not None
+    assert d.observe(1.0) is None
+    assert d.observe(float("nan")) is not None  # new episode, no cooldown
+
+
+def test_grad_norm_spike_detector_threshold_and_hysteresis():
+    from deepspeed_tpu.telemetry import GradNormSpikeDetector
+    d = GradNormSpikeDetector(spike_ratio=10.0, warmup=4, cooldown_s=0.0)
+    for _ in range(6):
+        assert d.observe(1.0) is None  # builds the EMA baseline
+    ema_before = d._ema
+    alert = d.observe(100.0)
+    assert alert is not None and alert.attrs["ratio"] == pytest.approx(100.0, rel=0.1)
+    assert d._ema == ema_before  # spike excluded from the EMA
+    assert d.observe(100.0) is None  # latched while still spiking
+    assert d.observe(1.0) is None    # recovery re-arms
+    assert d.observe(100.0) is not None  # next spike is a new episode
+    assert d.observe(float("nan")) is None  # latched again; non-finite path
+
+
+def test_grad_norm_spike_detector_warmup_suppresses():
+    from deepspeed_tpu.telemetry import GradNormSpikeDetector
+    d = GradNormSpikeDetector(spike_ratio=10.0, warmup=8, cooldown_s=0.0)
+    assert d.observe(1.0) is None
+    assert d.observe(50.0) is None  # only 1 sample seen: still warming up
+
+
+def test_queue_stall_detector_event_feed_and_poll():
+    from deepspeed_tpu.telemetry import QueueStallDetector
+    d = QueueStallDetector(stall_s=0.05, cooldown_s=0.0)
+    assert d.poll(now=100.0) is None  # idle queue never stalls
+    d.on_event(100.0, "enqueue", 1, {})
+    d.on_event(100.0, "enqueue", 2, {})
+    assert d.stalled_for(now=100.04) == pytest.approx(0.04)
+    assert d.poll(now=100.04) is None  # under threshold
+    alert = d.poll(now=100.2)
+    assert alert is not None and alert.attrs["pending"] == 2
+    assert d.poll(now=100.3) is None  # latched
+    d.on_event(100.35, "admit", 1, {})  # progress re-arms
+    assert d.poll(now=100.36) is None  # clock restarted from the admit
+    assert d.poll(now=100.5) is not None  # uid 2 still waiting -> new episode
+
+
+def test_slo_burn_detector_window_and_rearm():
+    from deepspeed_tpu.telemetry import SLOBurnRateDetector
+    d = SLOBurnRateDetector(ttft_sla_s=1.0, tpot_sla_s=0.25, window=8,
+                            burn_threshold=0.5, min_count=4, cooldown_s=0.0)
+    assert d.observe(5.0, 5.0) is None  # below min_count: no verdict yet
+    assert d.observe(5.0, 5.0) is None
+    assert d.observe(5.0, 5.0) is None
+    alert = d.observe(5.0, 5.0)
+    assert alert is not None and alert.attrs["burn_rate"] == 1.0
+    assert d.observe(5.0, 5.0) is None  # latched
+    for _ in range(8):
+        d.observe(0.1, 0.01)  # healthy requests flush the window
+    assert not d.firing  # re-armed at low burn rate
+    for _ in range(8):
+        alert = d.observe(9.0, 9.0) or alert
+    assert alert.attrs["burn_rate"] >= 0.5  # fires again on the next burn
+
+
+# ---------------------------------------------------------- health monitor
+
+def _mk_monitor():
+    from deepspeed_tpu.telemetry import CallbackAlertSink, EventLog, HealthMonitor
+    reg = MetricsRegistry()
+    ev = EventLog(registry=reg)
+    got = []
+    hm = HealthMonitor(registry=reg, event_log=ev,
+                       sinks=[CallbackAlertSink(got.append)])
+    ev.add_listener(hm.on_event)
+    return hm, reg, ev, got
+
+
+def test_health_monitor_nan_loss_exactly_one_alert():
+    from deepspeed_tpu.telemetry import NonFiniteLossDetector
+    hm, reg, ev, got = _mk_monitor()
+    hm.ensure_detector(NonFiniteLossDetector(cooldown_s=0.0))
+    assert reg.peek("health_status") == 1.0 and hm.healthy
+    for _ in range(20):
+        hm.observe_loss(float("nan"))
+    assert len(got) == 1 and got[0].detector == "nan_loss"
+    assert reg.peek("health_status") == 0.0 and not hm.healthy
+    assert reg.peek("health_alerts_total", detector="nan_loss") == 1
+    # the alert also lands in the event log as a structured record
+    assert [e["detector"] for e in ev.events(kind="alert")] == ["nan_loss"]
+    hm.observe_loss(0.5)  # recovery re-arms and restores the gauge
+    assert reg.peek("health_status") == 1.0 and hm.healthy
+
+
+def test_health_monitor_queue_stall_exactly_one_alert():
+    from deepspeed_tpu.telemetry import QueueStallDetector
+    hm, reg, ev, got = _mk_monitor()
+    hm.ensure_detector(QueueStallDetector(stall_s=0.03, cooldown_s=0.0))
+    ev.emit("enqueue", 1, ts=50.0, prompt=4)  # listener feeds the detector
+    for now in (50.1, 50.2, 50.3):
+        hm.poll(now=now)
+    assert len(got) == 1 and got[0].detector == "queue_stall"
+    assert reg.peek("health_status") == 0.0
+    ev.emit("admit", 1, ts=50.4, hit=0)
+    hm.poll(now=50.41)
+    assert reg.peek("health_status") == 1.0 and hm.healthy
+
+
+def test_health_monitor_external_alert_and_sink_isolation():
+    from deepspeed_tpu.telemetry import CallbackAlertSink
+    hm, reg, ev, got = _mk_monitor()
+    hm.add_sink(CallbackAlertSink(lambda a: 1 / 0))  # broken sink: swallowed
+    hm.raise_alert("dataloader", "shard unreadable", severity="error", shard=3)
+    assert len(got) == 1 and got[0].attrs == {"shard": 3}
+    assert not hm.healthy
+    hm.resolve("dataloader")
+    assert hm.healthy
+    hm.raise_alert("x", "y")
+    hm.reset()
+    assert hm.healthy and hm.alerts() == []
+
+
+def test_health_monitor_ensure_detector_idempotent():
+    from deepspeed_tpu.telemetry import NonFiniteLossDetector
+    hm, _, _, _ = _mk_monitor()
+    first = hm.ensure_detector(NonFiniteLossDetector())
+    second = hm.ensure_detector(NonFiniteLossDetector())
+    assert first is second  # repeated engine construction keeps one state
+
+
+def test_jsonl_alert_sink(tmp_path):
+    from deepspeed_tpu.telemetry import Alert, JsonlAlertSink
+    path = tmp_path / "alerts.jsonl"
+    sink = JsonlAlertSink(str(path))
+    sink(Alert(detector="d1", severity="error", message="m", attrs={"k": 1}))
+    sink(Alert(detector="d2", severity="warning", message="n"))
+    recs = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [r["detector"] for r in recs] == ["d1", "d2"]
+    assert recs[0]["k"] == 1 and recs[0]["severity"] == "error"
+
+
+def test_watchdog_timeout_raises_structured_alert():
+    """A wedged call trips the watchdog with a structured health alert
+    (not just a bare counter): docs/OBSERVABILITY.md health section."""
+    from deepspeed_tpu.telemetry import get_health_monitor
+    from deepspeed_tpu.utils.watchdog import run_with_watchdog
+    hm = get_health_monitor()
+    hm.reset()
+    hm.resolve("watchdog_timeout")
+    n0 = len([a for a in hm.alerts() if a.detector == "watchdog_timeout"])
+    status, _ = run_with_watchdog(lambda: time.sleep(5), timeout_s=0.05)
+    assert status == "timeout"
+    alerts = [a for a in hm.alerts() if a.detector == "watchdog_timeout"]
+    assert len(alerts) == n0 + 1
+    assert alerts[-1].attrs["timeout_s"] == pytest.approx(0.05)
+    assert not hm.healthy  # external alert holds status at 0 until resolved
+    hm.resolve("watchdog_timeout")
+    hm.reset()
+    assert hm.healthy
+
+
+# ----------------------------------------------------------- doc drift
+
+_METRIC_PREFIXES = ("train_", "comm_", "infer_", "kv_", "sched_",
+                    "compile_cache_", "watchdog_", "telemetry_", "health_")
+_EXTRA_METRICS = {"last_step_completed_unix"}
+
+
+def test_metric_catalog_matches_docs():
+    """Doc-drift guard: every metric name registered by package code must
+    appear in docs/OBSERVABILITY.md's catalog, and every catalog name must
+    exist in code — a rename or addition that skips the docs fails here."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(__file__).resolve().parents[2]
+    pkg = root / "deepspeed_tpu"
+    code_names = set()
+    call_re = re.compile(r'\.(?:counter|gauge|histogram)\(\s*"([a-z0-9_]+)"')
+    for py in pkg.rglob("*.py"):
+        code_names |= set(call_re.findall(py.read_text()))
+    assert code_names, "metric scan found nothing — pattern rotted?"
+
+    doc = (root / "docs" / "OBSERVABILITY.md").read_text()
+    doc_names = {m for m in re.findall(r"`([a-z][a-z0-9_]*)[`{]", doc)
+                 if m.startswith(_METRIC_PREFIXES) or m in _EXTRA_METRICS}
+
+    undocumented = code_names - doc_names
+    assert not undocumented, f"metrics registered in code but absent from docs/OBSERVABILITY.md: {sorted(undocumented)}"
+    phantom = doc_names - code_names
+    assert not phantom, f"metrics documented but not registered anywhere in code: {sorted(phantom)}"
