@@ -45,9 +45,11 @@ def build_step_fns(model) -> Tuple:
     return jax.jit(prefill, donate_argnums=(2,)), jax.jit(decode_step, donate_argnums=(2,))
 
 
-def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, top_p: float = 1.0):
-    if not do_sample or temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
+def filter_logits(logits, temperature: float, top_k: int, top_p: float = 1.0):
+    """Temperature/top-k/nucleus masking over (B, V) logits — the exact
+    distribution ``sample_logits`` draws from, exposed separately so the
+    speculative-decode verifier (``inference/v2/spec.py``) can score
+    drafts against the same filtered target distribution."""
     logits = logits / jnp.maximum(temperature, 1e-6)
     if top_k > 0:
         vals, _ = jax.lax.top_k(logits, top_k)
@@ -62,7 +64,13 @@ def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, 
         keep = keep.at[:, 0].set(True)  # top-1 always survives (top_p <= 0 == greedy)
         cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
         logits = jnp.where(logits < cutoff[:, None], -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    return logits
+
+
+def sample_logits(logits, rng, do_sample: bool, temperature: float, top_k: int, top_p: float = 1.0):
+    if not do_sample or temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    return jax.random.categorical(rng, filter_logits(logits, temperature, top_k, top_p), axis=-1)
 
 
 def _build_fused_decode(model, max_new_tokens: int, do_sample: bool, temperature: float, top_k: int,

@@ -14,6 +14,7 @@ from .ragged import (BlockedAllocator, DSSequenceDescriptor, DSStateManager, Pre
 from .scheduler import RaggedRequest, RaggedBatchScheduler
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
 from .sla import LoadSpec, RequestStat, effective_throughput_at_sla, run_load, summarize, sweep
+from .spec import Drafter, NullDrafter, PromptLookupDrafter, make_drafter
 
 __all__ = [
     "BlockedAllocator",
@@ -31,4 +32,8 @@ __all__ = [
     "summarize",
     "sweep",
     "effective_throughput_at_sla",
+    "Drafter",
+    "NullDrafter",
+    "PromptLookupDrafter",
+    "make_drafter",
 ]

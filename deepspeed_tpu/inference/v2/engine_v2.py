@@ -31,9 +31,11 @@ from ...telemetry.events import get_event_log
 from ...telemetry.health import (QueueStallDetector, SLOBurnRateDetector,
                                  get_health_monitor)
 from ...utils.logging import log_dist, logger
-from .model_runner import make_burst_fn, make_fused_step_fn, make_step_fns
+from .model_runner import (make_burst_fn, make_fused_step_fn, make_spec_verify_fn,
+                           make_step_fns)
 from .ragged.manager import DSStateManager, RaggedBatchConfig
 from .scheduler import FusedQuantum, RaggedBatchScheduler, RaggedRequest
+from .spec import make_drafter
 
 
 def _next_pow2(n: int) -> int:
@@ -57,6 +59,11 @@ class RaggedInferenceEngineConfig:
     enable_prefix_cache: Optional[bool] = None  # radix prefix cache: retired prompts keep their
     # KV blocks in a radix tree, new requests skip prefilling a cached prefix
     # (docs/SERVING.md). None: on unless DS_TPU_PREFIX_CACHE=0.
+    spec_decode: Optional[bool] = None  # speculative decoding: draft K tokens per decode row
+    # and verify them in ONE dispatch (docs/SERVING.md "Speculative decoding").
+    # None: off unless DS_TPU_SPEC_DECODE=1.
+    spec_k: Optional[int] = None  # max draft tokens per row per step. None: DS_TPU_SPEC_K (default 4).
+    spec_drafter: str = "prompt_lookup"  # drafter registry name (inference/v2/spec.py)
     min_decode_bucket: int = 8  # floor for the padded decode batch: fewer compiled
     # (B, steps) shapes (padded rows write to the garbage page, so a bigger
     # bucket costs nothing real); 1 restores exact power-of-two bucketing
@@ -151,6 +158,11 @@ class InferenceEngineV2:
         self._m_dispatches = tele.counter("infer_dispatches_total")
         self._m_fused_quanta = tele.counter("infer_fused_quanta_total")
         self._m_fused_fill = tele.gauge("infer_fused_batch_fill")
+        # speculative decoding: draft/accept accounting (the rollback
+        # counter lives in the state manager next to the block bookkeeping)
+        self._m_spec_proposed = tele.counter("spec_tokens_proposed_total")
+        self._m_spec_accepted = tele.counter("spec_tokens_accepted_total")
+        self._m_spec_rate = tele.gauge("spec_acceptance_rate")
         # request-lifecycle event log + serving health detectors
         self._events = get_event_log()
         self._health = get_health_monitor()
@@ -200,6 +212,18 @@ class InferenceEngineV2:
         if fused is None:
             fused = os.environ.get("DS_TPU_SERVE_FUSED", "1") != "0"
         self._fused_enabled = bool(fused)
+        spec = config.spec_decode
+        if spec is None:
+            spec = os.environ.get("DS_TPU_SPEC_DECODE", "0") != "0"
+        self._spec_enabled = bool(spec)
+        spec_k = config.spec_k
+        if spec_k is None:
+            spec_k = int(os.environ.get("DS_TPU_SPEC_K", "4") or 4)
+        self._spec_k = max(1, int(spec_k))
+        self._drafter = make_drafter(config.spec_drafter)
+        self._spec_fns: Dict[tuple, object] = {}  # (chunk, sampling) -> jitted verify
+        self._spec_proposed_run = 0  # cumulative, for the acceptance-rate gauge
+        self._spec_accepted_run = 0
         self._sampling = None  # (do_sample, temperature, top_k, top_p) during generate()
         self._rng = jax.random.PRNGKey(0)
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
@@ -721,6 +745,130 @@ class InferenceEngineV2:
                 out[pf.uid] = None
         return out
 
+    # ---------------------------------------------------------- speculative decode
+    _MAX_SPEC_VARIANTS = 8
+
+    def _spec_for(self, chunk: int, sampling):
+        """LRU-bounded cache of spec-verify programs keyed on (window
+        length, sampling signature) — same eviction discipline as
+        ``_burst_for``/``_fused_for``. The padded row count rides jit's
+        shape specialization; only the verify window is static."""
+        key = (chunk,) + (sampling or (False, 1.0, 0, 1.0))
+        if key not in self._spec_fns:
+            if len(self._spec_fns) >= self._MAX_SPEC_VARIANTS:
+                self._spec_fns.pop(next(iter(self._spec_fns)))
+            do, t, k, p = key[1:]
+            self._spec_fns[key] = make_spec_verify_fn(self._run_cfg, interpret=self._interpret,
+                                                      mesh=self._run_mesh, tp=self._tp, chunk=chunk,
+                                                      do_sample=do, temperature=t, top_k=k, top_p=p)
+        else:
+            self._spec_fns[key] = self._spec_fns.pop(key)  # LRU touch
+        return self._spec_fns[key]
+
+    def _run_spec_step(self, uids: List[int], carries: List[int], histories: List[Sequence[int]],
+                       budgets: List[int]) -> Optional[Dict[int, List[int]]]:
+        """One draft→verify speculative-decode quantum over pure-decode rows.
+
+        Host side: the drafter proposes up to K tokens per row from its
+        prompt+generated history; the verify window is ``chunk = kmax
+        rounded up to a power of two, + 1`` (carry token + drafts), so
+        draft-poor steps compile/pad small. Device side: ONE dispatch runs
+        every row as a (start, len=chunk) ragged chunked-prefill through
+        the same paged-attention machinery as the fused step, writing the
+        window's KV optimistically, and ``select_committed`` picks each
+        row's accepted prefix + bonus token in-graph — the readback is
+        (B, chunk) committed ids + (B,) counts, ints only. Rejected tail
+        positions roll back via ``DSStateManager.rollback_tokens``.
+
+        Returns uid -> committed tokens (1..chunk each) for the rows that
+        ran, or None when no row drafted anything / none were admitted —
+        the caller falls back to a plain decode step, so a cold drafter
+        costs zero extra verify positions.
+        """
+        K = self._spec_k
+        drafts: List[List[int]] = []
+        for uid, hist, budget in zip(uids, histories, budgets):
+            seq = self.state.get_sequence(uid)
+            cap = min(K, budget - 1, self.state.max_context - seq.seen_tokens - 1)
+            d = self._drafter.propose(hist, cap) if cap > 0 else []
+            drafts.append([int(t) for t in d[:max(0, cap)]])
+        kmax = max((len(d) for d in drafts), default=0)
+        if kmax == 0:
+            return None  # nothing to verify: plain decode is strictly cheaper
+        chunk = min(K, _next_pow2(kmax)) + 1
+        admitted, q = self.scheduler.schedule_spec(uids, chunk)
+        if not admitted:
+            return None
+        by_uid = {u: i for i, u in enumerate(uids)}
+        n = len(admitted)
+        B = self._decode_bucket(n)
+        T = B * chunk
+        bs = self.state.block_size
+
+        ids = np.zeros((T,), np.int32)
+        positions = np.tile(np.arange(chunk, dtype=np.int32), B)
+        slots = self._garbage_slots(T)
+        ctx = np.full((B,), chunk, np.int32)  # padded rows attend inside the garbage page
+        bt = np.full((B, self._max_blocks_per_seq), self._garbage_block, np.int32)
+        n_draft = np.zeros((B,), np.int32)
+        seqs = []
+        for j, uid in enumerate(admitted):
+            i = by_uid[uid]
+            seq = self.state.get_sequence(uid)
+            self._cow_ready(seq, seq.seen_tokens)
+            self.state.allocate_for(seq, chunk)
+            seq.record_tokens(None)  # committed tokens are resolved post-verify
+            seq.pre_forward(chunk)
+            pos0 = seq.seen_tokens
+            blocks = np.asarray(seq.blocks, np.int32)
+            d = drafts[i]
+            base = j * chunk
+            ids[base] = int(carries[i])
+            ids[base + 1:base + 1 + len(d)] = d
+            pos = pos0 + np.arange(chunk)
+            positions[base:base + chunk] = pos
+            slots[base:base + chunk] = blocks[pos // bs] * bs + pos % bs
+            ctx[j] = pos0 + chunk
+            bt[j] = self._seq_block_row(seq)
+            n_draft[j] = len(d)
+            seqs.append(seq)
+
+        fn = self._spec_for(chunk, self._sampling)
+        self._rng, rng = jax.random.split(self._rng)
+        with telemetry_span("infer/spec_verify", rows=n, k=chunk - 1):
+            committed, accepted, self.k_pages, self.v_pages = fn(
+                self.params, jnp.asarray(ids), jnp.asarray(positions), self.k_pages,
+                self.v_pages, jnp.asarray(bt), jnp.asarray(ctx), jnp.asarray(slots),
+                jnp.asarray(n_draft), rng)
+        self._m_dispatches.inc()
+        self._m_decode_steps.inc()
+        self._m_decode_fill.set(n / B)
+        committed = np.asarray(committed)  # (B, chunk) ints + (B,) counts: the
+        accepted = np.asarray(accepted)    # whole readback for up to B*chunk tokens
+        for seq in seqs:
+            seq.post_forward()
+
+        out: Dict[int, List[int]] = {}
+        total_acc = 0
+        ev = self._events.enabled
+        for j, uid in enumerate(admitted):
+            acc = int(accepted[j])
+            n_commit = acc + 1
+            self.state.rollback_tokens(seqs[j], chunk - n_commit)
+            out[uid] = [int(t) for t in committed[j, :n_commit]]
+            total_acc += acc
+            if ev:
+                self._events.emit("decode", uid, q=q, k=n_commit, accepted=acc)
+        total_prop = int(n_draft[:n].sum())
+        self._m_decode_tokens.inc(n + total_acc)
+        self._m_spec_proposed.inc(total_prop)
+        self._m_spec_accepted.inc(total_acc)
+        self._spec_proposed_run += total_prop
+        self._spec_accepted_run += total_acc
+        if self._spec_proposed_run:
+            self._m_spec_rate.set(self._spec_accepted_run / self._spec_proposed_run)
+        return out
+
     # ---------------------------------------------------------- serving loop
     def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
                  eos_token_id: Optional[int] = None, do_sample: bool = False, temperature: float = 1.0,
@@ -761,11 +909,16 @@ class InferenceEngineV2:
         def commit(uid: int, toks_out: List[int]) -> None:
             """Record sampled tokens and retire/continue the request."""
             req = reqs[uid]
+            # a multi-token commit (burst tail, speculative window) never
+            # outlives the request budget: clamp BEFORE recording, so
+            # results and the streaming callback agree token-for-token
+            toks_out = list(toks_out)[:req.max_new_tokens - len(results[uid])]
+            if not toks_out:
+                return
             if eos_token_id is not None and eos_token_id in toks_out:
                 toks_out = toks_out[:toks_out.index(eos_token_id) + 1]
             if on_token is not None:
-                budget = req.max_new_tokens - len(results[uid])
-                for tok in toks_out[:budget]:
+                for tok in toks_out:
                     on_token(uid, tok)
             first = not results[uid]
             results[uid].extend(toks_out)
@@ -825,7 +978,9 @@ class InferenceEngineV2:
         unfused burst path, bursts stay on even with an EOS cut or a
         streaming callback: finished rows are masked in-graph and the
         host truncates at commit."""
-        deferred = eos_token_id is None and on_token is None
+        # speculation needs committed token VALUES on the host each step
+        # (the drafter reads the history), so it forces non-deferred mode
+        deferred = eos_token_id is None and on_token is None and not self._spec_enabled
         reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
         pending = list(reqs.values())
         decode_ready: Dict[int, object] = {}  # uid -> next token to feed (int, or device scalar when deferred)
@@ -837,6 +992,20 @@ class InferenceEngineV2:
 
         while pending or decode_ready:
             self._health.poll()
+            if self._spec_enabled and decode_ready and not pending:
+                # pure-decode situation: try a draft→verify quantum. Rows
+                # the drafter/scheduler skipped stay in decode_ready and
+                # rotate to the front of the next step.
+                sp_uids = list(decode_ready)
+                rows = self._run_spec_step(
+                    sp_uids, [decode_ready[u] for u in sp_uids],
+                    [list(prompts[u]) + results[u] for u in sp_uids],
+                    [reqs[u].max_new_tokens - len(results[u]) for u in sp_uids])
+                if rows is not None:
+                    for uid, toks in rows.items():
+                        decode_ready.pop(uid)
+                        commit(uid, toks)
+                    continue
             quantum = self.scheduler.schedule_fused([r for r in pending if r.remaining_prefill],
                                                     list(decode_ready))
             if quantum.empty:
@@ -874,7 +1043,7 @@ class InferenceEngineV2:
         # readback is a ~100 ms roundtrip; the first on-chip serve capture
         # (round 5) measured the synchronous loop 20x below the decode
         # ceiling for exactly this reason.
-        deferred = eos_token_id is None and on_token is None
+        deferred = eos_token_id is None and on_token is None and not self._spec_enabled
         reqs = {i: RaggedRequest(uid=i, tokens=list(p), max_new_tokens=max_new_tokens) for i, p in enumerate(prompts)}
         pending = list(reqs.values())
         decode_ready: Dict[int, object] = {}  # uid -> next token to feed (int, or device scalar when deferred)
@@ -886,6 +1055,19 @@ class InferenceEngineV2:
 
         while pending or decode_ready:
             self._health.poll()
+            if self._spec_enabled and not pending and decode_ready:
+                # pure-decode situation: draft→verify quantum first; on a
+                # dry drafter fall through to the burst / stepped path
+                sp_uids = list(decode_ready)
+                rows = self._run_spec_step(
+                    sp_uids, [decode_ready[u] for u in sp_uids],
+                    [list(prompts[u]) + results[u] for u in sp_uids],
+                    [reqs[u].max_new_tokens - len(results[u]) for u in sp_uids])
+                if rows is not None:
+                    for uid, toks in rows.items():
+                        decode_ready.pop(uid)
+                        commit(uid, toks)
+                    continue
             # Burst path: nothing left to admit and everyone is decoding —
             # run K fused steps on-device instead of K host roundtrips.
             # A sequence that hits EOS mid-burst wastes its tail steps

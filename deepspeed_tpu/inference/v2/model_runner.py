@@ -230,6 +230,89 @@ def fused_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, 
     return mods.unembed(cfg, params, x_last, zeros), k_pages, v_pages
 
 
+def spec_verify_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
+                        k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
+                        ctx_lens: jnp.ndarray, slot_mapping: jnp.ndarray, *, chunk: int,
+                        interpret: bool = False, mesh=None, tp: int = 1
+                        ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Speculative-decode verify pass: every row is a ``chunk = K+1``-token
+    tail (carry token + K drafts) of a live decoded sequence, run as a
+    chunked-prefill-with-history segment through the same
+    ``paged_attention_mixed`` machinery as the fused step — chunked
+    prefill against existing context IS verification. Unlike
+    ``fused_forward`` (which unembeds one position per row), acceptance
+    needs logits at EVERY position, so the whole flat batch unembeds:
+    returns ((T, V) fp32 logits, k_pages, v_pages) with T = B * chunk.
+    """
+    attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
+
+    mods = build_modules()
+    x = mods.embedding(cfg, params, input_ids[None], positions[None])  # (1, T, d)
+    cos = sin = None
+    if cfg.pos_emb == "rope":
+        cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
+    slopes = jnp.asarray(alibi_slopes(cfg.n_heads)) if cfg.pos_emb == "alibi" else None
+    pos2d = positions[None]
+
+    for i in range(cfg.n_layers):
+        lp = params[f"layer_{i}"]
+        w_i = cfg.window_for(i)
+        decode_attn, prefill_attn, decode_native = attn_fns(w_i)
+
+        def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
+            out = paged_attention_mixed(q[0], kp, vp, block_tables, ctx_lens, positions,
+                                        n_dec=0, chunk=chunk, scale=cfg.attn_scale,
+                                        alibi_slopes=slopes, window=_w,
+                                        decode_fn=_da, prefill_fn=_pa, native=_dn)
+            return out[None]  # (1, T, H, D)
+
+        x, kp, vp = _transformer_layer(cfg, lp, x, k_pages[i], v_pages[i], slot_mapping, cos, sin,
+                                       pos2d, attn_apply, mods, _is_moe_layer(cfg, i))
+        k_pages = k_pages.at[i].set(kp)
+        v_pages = v_pages.at[i].set(vp)
+
+    # unembed every flat position: (T, 1, d) rows through the module's
+    # (batch, seq) contract — T is small (rows x (K+1)), so the full
+    # (T, V) logit block stays cheap and the acceptance math runs in-graph
+    x_all = x[0][:, None, :]
+    zeros = jnp.zeros((x_all.shape[0],), jnp.int32)
+    return mods.unembed(cfg, params, x_all, zeros), k_pages, v_pages
+
+
+def make_spec_verify_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1, *,
+                        chunk: int, do_sample: bool = False, temperature: float = 1.0,
+                        top_k: int = 0, top_p: float = 1.0):
+    """Jitted single-dispatch K-token verify (speculative decoding).
+
+    One program per (chunk, sampling) signature: the verify forward
+    scores all ``chunk = K+1`` positions per row, then device-side
+    acceptance (``spec.select_committed``) picks each row's accepted
+    draft count and its bonus/correction token in-graph — the host reads
+    back one (B, chunk) int32 token block plus a (B,) int32 count, the
+    same small-readback discipline as the fused burst. ``n_draft`` caps
+    acceptance per row so short/padded draft windows never commit pad
+    positions; rejected tail positions are rolled back by the state
+    manager after the dispatch.
+    """
+    from .spec import select_committed
+
+    fwd = functools.partial(spec_verify_forward, cfg, chunk=chunk, interpret=interpret, mesh=mesh, tp=tp)
+
+    def verify(params, ids, positions, k_pages, v_pages, block_tables, ctx, slots, n_draft, rng):
+        # ids/positions/slots: (T,) flat, T = B * chunk; block_tables (B, P);
+        # ctx/n_draft: (B,)
+        logits, k_pages, v_pages = fwd(params, ids, positions, k_pages, v_pages,
+                                       block_tables, ctx, slots)
+        B = ctx.shape[0]
+        lg = logits.reshape(B, chunk, -1)
+        drafts = ids.reshape(B, chunk)[:, 1:]
+        committed, accepted = select_committed(lg, drafts, n_draft, rng, do_sample=do_sample,
+                                               temperature=temperature, top_k=top_k, top_p=top_p)
+        return committed, accepted.astype(jnp.int32), k_pages, v_pages
+
+    return jax.jit(verify, donate_argnums=(3, 4))
+
+
 def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
     """Jitted (prefill_fn, decode_fn) with donated page buffers."""
     prefill = jax.jit(functools.partial(ragged_forward, cfg, decode=False, interpret=interpret, mesh=mesh, tp=tp),

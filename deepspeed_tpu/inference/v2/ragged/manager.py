@@ -64,6 +64,7 @@ class DSStateManager:
         self._m_allocated = tele.counter("kv_blocks_allocated_total")
         self._m_flushed = tele.counter("kv_sequences_flushed_total")
         self._m_cow = tele.counter("kv_cow_copies_total")
+        self._m_spec_rollback = tele.counter("spec_rollback_tokens_total")
         tele.gauge("kv_blocks_total").set(num_kv_blocks)
         self._events = get_event_log()
         self._sync_gauges()
@@ -203,6 +204,33 @@ class DSStateManager:
         if seq is not None and seq.blocks:
             row[:len(seq.blocks)] = seq.blocks
         return row
+
+    def rollback_tokens(self, seq: DSSequenceDescriptor, n_tokens: int) -> int:
+        """Speculative-decode rollback: drop the last ``n_tokens`` KV
+        positions of ``seq`` (rejected draft writes) and release any tail
+        blocks the shortened sequence no longer covers. Only ever touches
+        blocks the sequence exclusively owns: copy-on-write ran before
+        the verify write, so ``shared_blocks`` (prefix-cache/COW-shared
+        pages) always ends at or before the rollback region — they are
+        never released or mutated here. The abandoned slots are plain
+        overwritten by the next decode write at the same positions.
+        Returns the number of blocks released."""
+        if n_tokens <= 0:
+            return 0
+        if seq.in_flight_tokens:
+            raise RuntimeError(f"sequence {seq.uid}: rollback with {seq.in_flight_tokens} "
+                               "tokens in flight")
+        if n_tokens > seq.seen_tokens:
+            raise ValueError(f"sequence {seq.uid}: rollback of {n_tokens} > {seq.seen_tokens} seen")
+        seq.seen_tokens -= n_tokens
+        keep = max(-(-seq.seen_tokens // self.block_size), seq.shared_blocks)
+        released = seq.blocks[keep:]
+        if released:
+            self._allocator.release(released)
+            del seq.blocks[keep:]
+            self._sync_gauges()
+        self._m_spec_rollback.inc(n_tokens)
+        return len(released)
 
     def flush_sequence(self, uid: int) -> None:
         """Retire a sequence: its block-aligned known prefix is donated to

@@ -169,6 +169,41 @@ class RaggedBatchScheduler:
                               tokens=self.max_batch_tokens - budget)
         return ScheduledStep(prefills=prefills, decode_uids=sched_decodes)
 
+    def schedule_spec(self, decode_uids: List[int], tokens_per_row: int) -> Tuple[List[int], int]:
+        """Admit pure-decode rows for a draft→verify quantum (speculative
+        decoding): each admitted row costs ``tokens_per_row`` (the carry
+        token + K drafts) of the step token budget and must fit
+        ``blocks_needed(tokens_per_row)`` + COW blocks in the available
+        pool — the same back-pressure discipline as ``schedule``, with the
+        per-row footprint scaled to the verify window. Rows that do not
+        fit simply stay in ``decode_ready`` for a later step. Returns the
+        admitted uids and the claimed quantum id."""
+        budget = self.max_batch_tokens
+        free = self._state.available_blocks
+        admitted: List[int] = []
+        for uid in decode_uids:
+            seq = self._state.get_sequence(uid)
+            if seq is None:
+                continue
+            if budget < tokens_per_row or len(admitted) >= self.max_sequences:
+                break
+            if seq.seen_tokens + seq.in_flight_tokens + tokens_per_row > self._state.max_context:
+                continue  # the verify window would overflow this row's context
+            need = seq.blocks_needed(tokens_per_row) + seq.cow_blocks_needed(seq.seen_tokens)
+            if need > free:
+                continue  # back-pressure: leave it for the next step
+            free -= need
+            budget -= tokens_per_row
+            admitted.append(uid)
+        q = self.next_quantum()
+        self._m_decodes.inc(len(admitted))
+        self._m_step_tokens.set(len(admitted) * tokens_per_row)
+        self._m_quantum_rows.set(len(admitted))
+        if admitted:
+            self._events.emit("quantum", q=q, prefills=0, decodes=len(admitted),
+                              tokens=len(admitted) * tokens_per_row, spec_k=tokens_per_row - 1)
+        return admitted, q
+
     def schedule_fused(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> FusedQuantum:
         """Assemble one fused quantum: identical admission policy to
         ``schedule`` (decode priority, FIFO chunked prefill, block

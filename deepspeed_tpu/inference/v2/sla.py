@@ -124,6 +124,11 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
 
     def commit(uid: int, toks_out: List[int]) -> None:
         req = reqs[uid]
+        # multi-token commits (bursts, speculative windows) clamp to the
+        # remaining request budget before anything is recorded
+        toks_out = list(toks_out)[:req.max_new_tokens - len(results[uid])]
+        if not toks_out:
+            return
         if eos_token_id is not None and eos_token_id in toks_out:
             toks_out = toks_out[:toks_out.index(eos_token_id) + 1]
         t = now()
@@ -147,6 +152,7 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             decode_ready[uid] = toks_out[-1]
 
     fused = bool(getattr(engine, "_fused_enabled", False))
+    spec_on = bool(getattr(engine, "_spec_enabled", False))
 
     while next_idx < spec.n_requests or pending or decode_ready:
         admit_arrivals()
@@ -156,6 +162,20 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             time.sleep(max(0.0, arrivals[next_idx] - now()))
             continue
         arrivals_due = next_idx < spec.n_requests and arrivals[next_idx] <= now()
+        if spec_on and not pending and not arrivals_due and decode_ready:
+            # speculative decode: draft→verify quantum over the pure-decode
+            # batch (both fused and unfused engines share this step); a dry
+            # drafter falls through to the regular paths below
+            sp_uids = list(decode_ready)
+            rows = engine._run_spec_step(
+                sp_uids, [decode_ready[u] for u in sp_uids],
+                [list(prompts[u]) + results[u] for u in sp_uids],
+                [reqs[u].max_new_tokens - len(results[u]) for u in sp_uids])
+            if rows is not None:
+                for uid, toks_row in rows.items():
+                    decode_ready.pop(uid)
+                    commit(uid, toks_row)
+                continue
         if fused:
             # SplitFuse hot path: one dispatched program per scheduler
             # quantum. Pure-decode quanta with nothing due extend to a

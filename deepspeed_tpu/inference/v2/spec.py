@@ -1,0 +1,168 @@
+"""Speculative decoding: pluggable drafters + device-side acceptance.
+
+Decode is weight-read-bound — every step streams the full parameter set
+from HBM to retire ONE token per sequence. Speculative decoding drafts
+K candidate tokens cheaply on the host, then verifies all of them in a
+single forward (K+1 ragged positions through the same paged-attention
+machinery the SplitFuse step already runs), so one weight sweep can
+retire up to K+1 tokens. Two halves live here:
+
+- **Drafters** (host side): ``propose(history, k)`` returns up to ``k``
+  guesses for the next tokens. The zero-cost default is n-gram
+  **prompt-lookup** self-speculation: match the last n tokens of the
+  sequence's prompt+generated history against an earlier occurrence and
+  propose the tokens that followed it — no second model, strongest on
+  templated/repetitive workloads (the same ones the prefix cache
+  accelerates on the prefill side).
+- **Acceptance** (device side, jit-traceable): ``select_committed``
+  turns per-position verify logits + the draft tokens into committed
+  tokens and an accepted-draft count per row. Greedy mode is exact-match
+  prefix acceptance; sampled mode is standard rejection sampling for a
+  deterministic (delta) draft distribution, which provably preserves the
+  target distribution: accept draft ``d`` with probability ``p(d)``; on
+  the first rejection resample from ``p`` with ``d``'s mass removed and
+  renormalized; a fully-accepted window samples one bonus token.
+
+The engine only activates speculation on pure-decode quanta — mixed
+quanta already feed decode's weight reads with prefill FLOPs (the
+SplitFuse point), so drafting there buys nothing. See
+docs/SERVING.md "Speculative decoding".
+"""
+
+from typing import List, Protocol, Sequence, Tuple, runtime_checkable
+
+import jax
+import jax.numpy as jnp
+
+from ..generation import filter_logits
+
+
+@runtime_checkable
+class Drafter(Protocol):
+    """A drafter proposes up to ``k`` next-token guesses from the host-
+    visible token history (prompt + committed generations). Returning
+    fewer than ``k`` — or none — is always legal: rows without proposals
+    run as plain decode."""
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        ...
+
+
+class NullDrafter:
+    """Never proposes — speculation structurally on, effectively off."""
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        return []
+
+
+class PromptLookupDrafter:
+    """N-gram prompt-lookup self-speculation.
+
+    Matches the last ``n`` history tokens (``n`` from ``max_ngram`` down
+    to ``min_ngram``) against the most recent earlier occurrence of the
+    same n-gram anywhere in the prompt+generated history and proposes
+    the tokens that followed it. O(len(history)) per call on short
+    serving histories; no model, no device work.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1):
+        if min_ngram < 1 or max_ngram < min_ngram:
+            raise ValueError(f"bad ngram range [{min_ngram}, {max_ngram}]")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, history: Sequence[int], k: int) -> List[int]:
+        hist = list(history)
+        L = len(hist)
+        if k <= 0 or L < self.min_ngram + 1:
+            return []
+        top = min(self.max_ngram, L - 1)
+        for n in range(top, self.min_ngram - 1, -1):
+            tail = hist[L - n:]
+            # most recent earlier occurrence wins: recent context is the
+            # best predictor once generation falls into a template/cycle
+            for i in range(L - n - 1, -1, -1):
+                if hist[i:i + n] == tail:
+                    # confidence-scaled window: only a full max_ngram match
+                    # earns the whole budget; weaker (shorter-gram) matches
+                    # propose at most n tokens, so a wandering transient
+                    # wastes 1-2 verify slots instead of k
+                    take = k if n == top else min(k, n)
+                    # overlapping copy (LZ77-style): appending each copied
+                    # token lets the read cursor run past the original end
+                    # of history, so a cycle of period L - i - n < take
+                    # self-extends to the full window instead of stopping
+                    # one token past the match
+                    buf = hist[:]
+                    out: List[int] = []
+                    for j in range(i + n, i + n + take):
+                        tok = int(buf[j])
+                        out.append(tok)
+                        buf.append(tok)
+                    return out
+        return []
+
+
+def make_drafter(name: str) -> Drafter:
+    """Drafter registry: ``prompt_lookup`` (default) or ``null``."""
+    key = (name or "prompt_lookup").lower()
+    if key in ("prompt_lookup", "ngram"):
+        return PromptLookupDrafter()
+    if key in ("null", "none", "off"):
+        return NullDrafter()
+    raise ValueError(f"unknown drafter {name!r}: expected prompt_lookup | null")
+
+
+def select_committed(logits: jnp.ndarray, drafts: jnp.ndarray, n_draft: jnp.ndarray,
+                     rng, do_sample: bool = False, temperature: float = 1.0,
+                     top_k: int = 0, top_p: float = 1.0) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Device-side acceptance for one verify dispatch (jit-traceable).
+
+    ``logits``: (B, chunk, V) per-position target logits — position ``i``
+    scores the token FOLLOWING input token ``i`` of the row (input 0 is
+    the carry token, inputs 1..chunk-1 the drafts). ``drafts``:
+    (B, chunk-1) draft token ids, right-padded; ``n_draft``: (B,) count
+    of real drafts per row (pad positions can never be accepted).
+
+    Returns ``(committed, accepted)``: ``committed`` (B, chunk) int32
+    where row ``j``'s first ``accepted[j] + 1`` entries are the tokens to
+    commit (accepted drafts + one bonus/correction token); entries past
+    that are garbage. ``accepted`` (B,) int32 in [0, n_draft].
+    """
+    B, chunk, V = logits.shape
+    K = chunk - 1
+    valid = jnp.arange(K)[None, :] < n_draft[:, None]
+    if not do_sample or temperature == 0.0:
+        # greedy: a draft is accepted iff it IS the argmax; committed
+        # tokens are the argmaxes themselves, so the output stream is
+        # token-for-token what non-speculative greedy decode emits
+        tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, chunk)
+        match = (drafts == tgt[:, :K]) & valid
+        accepted = jnp.sum(jnp.cumprod(match.astype(jnp.int32), axis=1), axis=1)
+        return tgt, accepted
+
+    flt = filter_logits(logits.reshape(B * chunk, V), temperature, top_k, top_p)
+    flt = flt.reshape(B, chunk, V)
+    p = jax.nn.softmax(flt, axis=-1)
+    r_acc, r_res, r_pln = jax.random.split(rng, 3)
+    # delta draft distribution (prompt-lookup is deterministic): accept
+    # draft d_i with prob p_i(d_i)
+    p_draft = jnp.take_along_axis(p[:, :K], drafts[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    u = jax.random.uniform(r_acc, (B, K))
+    accept = (u < p_draft) & valid
+    accepted = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # correction sample at the rejection position: p with the rejected
+    # draft's mass zeroed, renormalized (max(p - q, 0) for a delta q)
+    draft_mask = jax.nn.one_hot(drafts, V, dtype=bool)
+    res = jax.random.categorical(r_res, jnp.where(draft_mask, -jnp.inf, flt[:, :K]), axis=-1)
+    # plain sample at every position: used for the bonus token after a
+    # fully-accepted window (and for rows whose window ended draft-free)
+    pln = jax.random.categorical(r_pln, flt, axis=-1)
+    idx = jnp.arange(chunk)[None, :]
+    pad = jnp.zeros((B, 1), jnp.int32)
+    d_pad = jnp.concatenate([drafts.astype(jnp.int32), pad], axis=1)
+    r_pad = jnp.concatenate([res.astype(jnp.int32), pad], axis=1)
+    rejected_here = (idx == accepted[:, None]) & (accepted[:, None] < n_draft[:, None])
+    committed = jnp.where(idx < accepted[:, None], d_pad,
+                          jnp.where(rejected_here, r_pad, pln.astype(jnp.int32)))
+    return committed, accepted

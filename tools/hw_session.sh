@@ -10,9 +10,10 @@
 #   so a dead tunnel costs seconds, not an hour of wedged timeouts
 #   with every later artifact silently missing;
 # - chip windows die early: rungs with ZERO hardware evidence (attn,
-#   attn_d64, longctx, serve_sla, serve_prefix, int8/int4 A/B — never
-#   measured on a real chip) run FIRST; re-measures of known-good
-#   numbers (full ladder, train sweep) spend whatever window is left.
+#   attn_d64, longctx, serve_sla, serve_prefix, serve_spec, int8/int4
+#   A/B — never measured on a real chip) run FIRST; re-measures of
+#   known-good numbers (full ladder, train sweep) spend whatever window
+#   is left.
 cd "$(dirname "$0")/.." || exit 1
 LOG=${1:-hw_session.log}
 : > "$LOG"
@@ -40,22 +41,22 @@ fi
 
 # ---- phase A: never-measured rungs (zero hardware evidence) ----
 i=0
-for rung in attn attn_d64 longctx serve_sla serve_prefix; do
+for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec; do
     i=$((i+1))
-    note "A$i/5 bench rung $rung (never measured on-chip)"
+    note "A$i/6 bench rung $rung (never measured on-chip)"
     DS_BENCH_EXTRA=0 DS_BENCH_RUNG=$rung timeout 1800 python bench.py >> "$LOG" 2>&1
     note "$rung rc=$?"
     probe
 done
 
-note "A6 int8 weight-only A/B (decode + serve rungs)"
+note "A7 int8 weight-only A/B (decode + serve rungs)"
 DS_BENCH_QUANT=8 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
 note "int8 decode rc=$?"
 DS_BENCH_QUANT=8 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
 note "int8 serve rc=$?"
 probe
 
-note "A7 int4 weight-only A/B (decode + serve rungs, packed storage)"
+note "A8 int4 weight-only A/B (decode + serve rungs, packed storage)"
 DS_BENCH_QUANT=4 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
 note "int4 decode rc=$?"
 DS_BENCH_QUANT=4 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve timeout 1200 python bench.py >> "$LOG" 2>&1
