@@ -115,7 +115,7 @@ class TPU_Accelerator(DeepSpeedAccelerator):
 
     # --- execution ---
     def synchronize(self, device_index=None):
-        (jnp.zeros(()) + 0).block_until_ready()
+        (jnp.zeros(()) + 0).block_until_ready()  # graft-lint: readback (synchronize() IS the sync)
 
     def empty_cache(self):
         # XLA owns the allocator; nearest analogue is freeing donated buffers,

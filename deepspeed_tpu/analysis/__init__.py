@@ -1,0 +1,10 @@
+"""Correctness tooling: static JAX-hazard checks and runtime sanitizers.
+
+Keep this module import-light: ``knobs`` is imported by ``utils/logging.py``
+(and therefore by essentially everything), so nothing here may import
+telemetry, jax, or numpy at module scope.
+"""
+
+from . import knobs  # noqa: F401
+
+__all__ = ["knobs"]

@@ -1,0 +1,123 @@
+"""JitAuditor: runtime recompile accounting for the serving program caches.
+
+Enabled via ``DS_TPU_JIT_AUDIT`` (see ``analysis/knobs.py``).
+The engine wraps every jitted serving program (prefill/decode step fns, the
+COW page copy, and each LRU-cached burst/fused/spec program) in
+``JitAuditor.wrap``; the wrapper derives an abstract *signature* from the
+call's argument shapes/dtypes — the same thing jit keys its trace cache
+on — so the first sighting of a signature is exactly one XLA compilation.
+
+After the caller declares steady state (``mark_steady()``, e.g. once the
+serving warmup finished), any NEW signature is a steady-state recompile:
+the counter ``infer_jit_steady_recompiles_total`` increments and ONE
+``jit_recompile_storm`` HealthMonitor alert is raised per steady episode.
+
+A wrapper re-created after LRU eviction counts as fresh compilations on
+purpose: the evicted executable is gone, so the next call really does
+pay a compile.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+def _leaf_signature(x: Any) -> Any:
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(shape), str(dtype))
+    if isinstance(x, (int, float, bool, complex)) or x is None:
+        # python scalars are traced as weak-typed values: the VALUE does not
+        # retrace, only the type does
+        return ("py", type(x).__name__)
+    if isinstance(x, (list, tuple)):
+        return ("seq", tuple(_leaf_signature(v) for v in x))
+    if isinstance(x, dict):
+        return ("map", tuple(sorted((k, _leaf_signature(v)) for k, v in x.items())))
+    return ("obj", type(x).__name__)
+
+
+class JitAuditor:
+    """Counts compilations per (wrapped program, argument signature)."""
+
+    def __init__(self, monitor: Optional[object] = None, use_telemetry: bool = True):
+        self._lock = threading.Lock()
+        self._seen: Dict[Tuple[int, str, Any], int] = {}
+        self._wrap_seq = 0
+        self.compiles = 0
+        self.steady = False
+        self.steady_recompiles = 0
+        self._alerted = False
+        self._monitor = monitor
+        self._m_compiles = self._m_steady = None
+        if use_telemetry:
+            from ..telemetry import get_registry
+
+            tele = get_registry()
+            self._m_compiles = tele.counter("infer_jit_compiles_total")
+            self._m_steady = tele.counter("infer_jit_steady_recompiles_total")
+
+    # ---------------------------------------------------------------- wiring
+    def wrap(self, name: str, fn):
+        """Return ``fn`` wrapped with signature accounting. Each wrap gets a
+        fresh instance id, so a program rebuilt after LRU eviction starts
+        with an empty signature set (its executables were freed)."""
+        with self._lock:
+            self._wrap_seq += 1
+            instance = self._wrap_seq
+
+        def wrapped(*args, **kwargs):
+            sig = _leaf_signature(args) if not kwargs else (
+                _leaf_signature(args), _leaf_signature(kwargs))
+            self._note(instance, name, sig)
+            return fn(*args, **kwargs)
+
+        wrapped.__wrapped__ = fn  # type: ignore[attr-defined]
+        wrapped._jit_audit_name = name  # type: ignore[attr-defined]
+        return wrapped
+
+    def _note(self, instance: int, name: str, sig: Any) -> None:
+        key = (instance, name, sig)
+        with self._lock:
+            count = self._seen.get(key, 0)
+            self._seen[key] = count + 1
+            if count:
+                return  # warm signature: no compile
+            self.compiles += 1
+            if self._m_compiles is not None:
+                self._m_compiles.inc()
+            if not self.steady:
+                return
+            self.steady_recompiles += 1
+            if self._m_steady is not None:
+                self._m_steady.inc()
+            already_alerted, self._alerted = self._alerted, True
+        if not already_alerted and self._monitor is not None:
+            self._monitor.raise_alert(
+                "jit_recompile_storm",
+                f"steady-state recompile: program {name!r} saw a new argument "
+                "signature after warmup — an unbucketed shape is leaking into jit",
+                program=name)
+
+    # ---------------------------------------------------------------- phases
+    def mark_steady(self) -> None:
+        """Declare warmup over: every later new signature is a recompile."""
+        with self._lock:
+            self.steady = True
+            self.steady_recompiles = 0
+            self._alerted = False
+        if self._monitor is not None:
+            try:
+                self._monitor.resolve("jit_recompile_storm")
+            except Exception:
+                pass
+
+    def reset(self) -> None:
+        with self._lock:
+            self._seen.clear()
+            self.compiles = 0
+            self.steady = False
+            self.steady_recompiles = 0
+            self._alerted = False

@@ -1,0 +1,257 @@
+"""Central registry for ``DS_TPU_*`` environment knobs.
+
+Every environment variable the package reads must be declared here with a
+default and a docstring; ``tools/graft_lint.py`` flags any ``os.environ`` /
+``os.getenv`` read of a ``DS_TPU_*`` name outside this module, and
+``tests/unit/test_graft_lint.py`` enforces code <-> registry <-> docs drift
+in both directions (mirroring the metric-catalog guard in test_telemetry).
+
+This module must stay stdlib-only: ``utils/logging.py`` (imported by nearly
+everything) resolves its level through it, so any package-internal import
+here would create a cycle.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob."""
+
+    name: str
+    default: Optional[str]
+    kind: str  # "str" | "int" | "float" | "bool"
+    doc: str
+    owner: str  # module that consumes (or sets) it
+    # Knobs the launcher/agent *sets* for child processes rather than reads.
+    set_only: bool = False
+
+
+_REGISTRY: Dict[str, Knob] = {}
+
+# Prefix knobs: dynamically-named families like DS_TPU_OP_<NAME> used by the
+# op registries. Reads of names starting with one of these prefixes are
+# sanctioned without per-name declarations.
+_PREFIXES: Dict[str, Knob] = {}
+
+
+def declare(
+    name: str,
+    default: Optional[str],
+    kind: str,
+    doc: str,
+    owner: str,
+    *,
+    prefix: bool = False,
+    set_only: bool = False,
+) -> Knob:
+    knob = Knob(name=name, default=default, kind=kind, doc=doc, owner=owner, set_only=set_only)
+    if prefix:
+        _PREFIXES[name] = knob
+    else:
+        _REGISTRY[name] = knob
+    return knob
+
+
+def all_knobs() -> Dict[str, Knob]:
+    return dict(_REGISTRY)
+
+
+def prefix_knobs() -> Dict[str, Knob]:
+    return dict(_PREFIXES)
+
+
+def is_declared(name: str) -> bool:
+    if name in _REGISTRY:
+        return True
+    return any(name.startswith(p) for p in _PREFIXES)
+
+
+def _lookup(name: str) -> Knob:
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        for p, knob in _PREFIXES.items():
+            if name.startswith(p):
+                return knob
+        raise KeyError(
+            f"environment knob {name!r} is not declared in deepspeed_tpu.analysis.knobs; "
+            "add a declare(...) entry with a default and docstring"
+        ) from None
+
+
+def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    knob = _lookup(name)
+    fallback = default if default is not None else knob.default
+    return os.environ.get(name, fallback)
+
+
+def get_int(name: str, default: Optional[int] = None) -> int:
+    knob = _lookup(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        if default is not None:
+            return default
+        return int(knob.default or 0)
+    return int(raw)
+
+
+def get_float(name: str, default: Optional[float] = None) -> float:
+    knob = _lookup(name)
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        if default is not None:
+            return default
+        return float(knob.default or 0.0)
+    return float(raw)
+
+
+_TRUTHY: Tuple[str, ...] = ("1", "true", "yes", "on")
+
+
+def get_bool(name: str, default: Optional[bool] = None) -> bool:
+    knob = _lookup(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        if default is not None:
+            return default
+        raw = knob.default or "0"
+    return raw.strip().lower() in _TRUTHY
+
+
+def is_set(name: str) -> bool:
+    _lookup(name)
+    return name in os.environ
+
+
+# ---------------------------------------------------------------------------
+# Declarations — one entry per DS_TPU_* knob in the codebase.
+# ---------------------------------------------------------------------------
+
+# Serving engine (inference/v2/engine_v2.py)
+declare("DS_TPU_SERVE_FUSED", "1", "bool",
+        "Serve with the single-dispatch fused SplitFuse step (0 falls back to the unfused loop).",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_SPEC_DECODE", "0", "bool",
+        "Enable speculative decoding (draft + single-dispatch K-token verify).",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_SPEC_K", "4", "int",
+        "Speculation depth: draft tokens proposed per verify dispatch.",
+        "inference/v2/engine_v2.py")
+
+# Paged-KV state manager (inference/v2/ragged/manager.py)
+declare("DS_TPU_PREFIX_CACHE", "1", "bool",
+        "Enable the radix prefix cache: retiring prompts donate KV blocks for reuse.",
+        "inference/v2/ragged/manager.py")
+
+# Runtime sanitizers (analysis/)
+declare("DS_TPU_KV_SANITIZE", "0", "bool",
+        "Shadow-refcount sanitizer for paged KV blocks: traps double-free, "
+        "leak-at-flush, and writes to shared blocks that skipped COW.",
+        "analysis/kv_sanitizer.py")
+declare("DS_TPU_JIT_AUDIT", "0", "bool",
+        "Wrap jitted serving programs in a JitAuditor that counts compilations "
+        "per signature and alerts on steady-state recompiles.",
+        "analysis/jit_audit.py")
+declare("DS_TPU_TRANSFER_GUARD", "0", "bool",
+        "Run fused/spec dispatch under jax.transfer_guard_device_to_host('disallow') "
+        "so implicit host readbacks raise instead of silently syncing.",
+        "analysis/transfer_guard.py")
+
+# Telemetry (telemetry/)
+declare("DS_TPU_TELEMETRY", "1", "bool",
+        "Master switch for the telemetry subsystem (metrics, traces, events).",
+        "telemetry/registry.py")
+declare("DS_TPU_TELEMETRY_FLUSH_STEPS", "1", "int",
+        "The training engine's monitor bridge flushes telemetry every N steps.",
+        "runtime/engine.py")
+declare("DS_TPU_TRACE_RING", "4096", "int",
+        "Capacity of the span tracer's ring buffer.",
+        "telemetry/tracing.py")
+declare("DS_TPU_TRACE_XLA", "0", "bool",
+        "Annotate spans into XLA via jax.profiler traces when profiling.",
+        "telemetry/tracing.py")
+declare("DS_TPU_EVENT_RING", "65536", "int",
+        "Capacity of the request-lifecycle event ring buffer.",
+        "telemetry/events.py")
+declare("DS_TPU_EVENT_LOG", None, "str",
+        "If set, append request-lifecycle events as JSONL to this path.",
+        "telemetry/events.py")
+declare("DS_TPU_HEALTH_LOG", None, "str",
+        "If set, append health alerts as JSONL to this path.",
+        "telemetry/health.py")
+declare("DS_TPU_STALL_S", "30", "float",
+        "Queue-stall detector threshold: alert when the oldest queued request "
+        "waits longer than this many seconds.",
+        "telemetry/health.py")
+
+# Ops / kernels
+declare("DS_TPU_OP_", None, "str",
+        "Per-op implementation override for the training op registry, e.g. "
+        "DS_TPU_OP_FLASH_ATTENTION=xla forces the XLA fallback for that op.",
+        "ops/registry.py", prefix=True)
+declare("DS_TPU_OP_V2_", None, "str",
+        "Per-op implementation override for the inference-v2 module registry.",
+        "inference/v2/modules.py", prefix=True)
+declare("DS_TPU_FLASH_BQ", "512", "int",
+        "Pallas flash-attention query-block size.",
+        "ops/pallas/flash_attention.py")
+declare("DS_TPU_FLASH_BK", "512", "int",
+        "Pallas flash-attention key-block size.",
+        "ops/pallas/flash_attention.py")
+declare("DS_TPU_CE_CHUNK", "0", "int",
+        "Fused cross-entropy vocab-chunk size (0 = derive from budget).",
+        "ops/fused_ce.py")
+declare("DS_TPU_CE_BUDGET_MB", "4096", "int",
+        "Memory budget (MB) used to derive the fused cross-entropy chunk size.",
+        "ops/fused_ce.py")
+declare("DS_TPU_BUILD_DIR", None, "str",
+        "Override the build/cache directory for natively-built op artifacts.",
+        "ops/native/builder.py")
+
+# Runtime / checkpoint
+declare("DS_TPU_CKPT_ENGINE", None, "str",
+        "Force a checkpoint engine backend (e.g. 'torch', 'tensorstore').",
+        "runtime/checkpoint_engine.py")
+
+# Utils
+declare("DS_TPU_LOG_LEVEL", "INFO", "str",
+        "Package log level (DEBUG/INFO/WARNING/ERROR).",
+        "utils/logging.py")
+declare("DS_TPU_MEMORY_DEBUG", "0", "bool",
+        "Print live/peak device-memory stats from see_memory_usage().",
+        "utils/memory.py")
+declare("DS_TPU_WATCHDOG_TIMEOUT_S", "180", "float",
+        "Default watchdog timeout for collective/step hangs (seconds).",
+        "utils/watchdog.py")
+
+# Distributed / launcher / elasticity
+declare("DS_TPU_COORDINATOR", None, "str",
+        "host:port for multi-host jax.distributed rendezvous.",
+        "comm/comm.py")
+declare("DS_TPU_NUM_PROCESSES", None, "int",
+        "Process count for multi-host rendezvous (defaults to world size).",
+        "comm/comm.py")
+declare("DS_TPU_PROCESS_ID", None, "int",
+        "This process's id for multi-host rendezvous (defaults to rank).",
+        "comm/comm.py")
+declare("DS_TPU_WORLD_CHIPS", None, "int",
+        "Total chip count across the elastic world; set by the launcher, "
+        "read by elasticity config validation.",
+        "launcher/launch.py, elasticity/elasticity.py")
+declare("DS_TPU_LOCAL_CHIPS", None, "str",
+        "Comma-separated chip ids assigned to this node (set by the launcher).",
+        "launcher/launch.py", set_only=True)
+declare("DS_TPU_NODE_RANK", None, "int",
+        "This node's rank in the launch topology (set by the launcher).",
+        "launcher/launch.py", set_only=True)
+declare("DS_TPU_ELASTIC_RESTART", None, "int",
+        "Current elastic restart round (set by the elastic agent for children).",
+        "elasticity/elastic_agent.py", set_only=True)
+declare("DS_TPU_ELASTIC_MAX_RESTARTS", None, "int",
+        "Maximum elastic restarts (set by the elastic agent for children).",
+        "elasticity/elastic_agent.py", set_only=True)

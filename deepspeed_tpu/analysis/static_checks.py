@@ -1,0 +1,721 @@
+"""graft-lint: AST-based static checks for project-specific JAX hazards.
+
+Four checks (docs/ANALYSIS.md has the catalog and sanction syntax):
+
+- ``host-sync``       implicit or unblessed device→host readbacks inside
+                      functions reachable from the serving hot path
+                      (sanction: ``# graft-lint: readback``)
+- ``jit-recompile``   shapes derived from raw Python ints reaching jit
+                      tracing — ``.at[:n]`` slices and ``jnp.stack`` over
+                      dynamically-sized lists — without routing through
+                      the pow2 bucketing helpers
+                      (sanction: ``# graft-lint: bucketed``)
+- ``donated-reuse``   a buffer passed at a donated position of a jitted
+                      call and referenced again afterwards without being
+                      rebound (sanction: ``# graft-lint: donated-ok``)
+- ``knob``            ``os.environ`` reads of ``DS_TPU_*`` outside
+                      ``analysis/knobs.py``, and knob names not declared
+                      in the registry (no sanction — migrate the read)
+
+This module is deliberately **stdlib-only with no package imports** so
+``tools/graft_lint.py`` can load it from the file path without importing
+``deepspeed_tpu`` (and therefore jax). Knob declarations are recovered by
+parsing ``knobs.py``'s AST, not by importing it.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# Functions on (or driving) the serving hot path: host-sync and recompile
+# hazards are only reported inside functions reachable from these roots
+# through the name-based call graph.
+HOT_ROOTS: Tuple[str, ...] = (
+    "_run_fused", "_run_spec_step", "_run_decode", "_run_decode_burst",
+    "_run_prefill_batch", "_generate_fused", "_generate_unfused", "put",
+    "run_load",
+)
+
+# Attribute names that ARE jitted programs (self._prefill_fn(...) etc.).
+JIT_CALLEE_ATTRS: Dict[str, Tuple[int, ...]] = {
+    "_prefill_fn": (3, 4),
+    "_decode_fn": (3, 4),
+    "_cow_fn": (0, 1),
+}
+# Methods whose return value is a jitted program donating (k_pages, v_pages).
+JIT_FACTORY_ATTRS: Dict[str, Tuple[int, ...]] = {
+    "_burst_for": (3, 4),
+    "_fused_for": (3, 4),
+    "_spec_for": (3, 4),
+}
+# Device-producing calls that are NOT sync hazards themselves.
+DEVICE_CALL_PREFIXES = ("jnp.", "jax.random.", "jax.lax.", "lax.")
+DEVICE_SELF_ATTRS = {"k_pages", "v_pages"}
+# Helpers that launder a raw Python int into a bucketed (bounded-ladder) size.
+BUCKET_HELPERS = {"_next_pow2", "_decode_bucket", "_fused_bucket", "_burst_steps", "next_pow2"}
+# Attribute reads that are host metadata, never a transfer.
+META_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "at"}
+
+SANCTIONS = {
+    "host-sync": "graft-lint: readback",
+    "jit-recompile": "graft-lint: bucketed",
+    "donated-reuse": "graft-lint: donated-ok",
+}
+
+ENV_PREFIX = "DS_TPU_"
+KNOBS_FILENAME = os.path.join("analysis", "knobs.py")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    """'jax.random.split' for an attribute chain, '' when not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _fstring_prefix(node: ast.AST) -> Optional[str]:
+    """Leading literal of an f-string ('DS_TPU_OP_' for f"DS_TPU_OP_{x}")."""
+    if isinstance(node, ast.JoinedStr) and node.values:
+        return _str_const(node.values[0])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# knobs.py declaration recovery (AST parse, no import)
+# ---------------------------------------------------------------------------
+
+def load_declared_knobs(knobs_path: str) -> Tuple[Set[str], Set[str]]:
+    """(declared names, declared prefixes) from declare() calls in knobs.py."""
+    with open(knobs_path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=knobs_path)
+    names: Set[str] = set()
+    prefixes: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+                and node.func.id == "declare" and node.args):
+            continue
+        name = _str_const(node.args[0])
+        if name is None:
+            continue
+        is_prefix = any(kw.arg == "prefix" and isinstance(kw.value, ast.Constant)
+                        and kw.value.value for kw in node.keywords)
+        (prefixes if is_prefix else names).add(name)
+    return names, prefixes
+
+
+# ---------------------------------------------------------------------------
+# call graph / reachability
+# ---------------------------------------------------------------------------
+
+def _function_nodes(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _own_statements(fn: ast.AST) -> List[ast.stmt]:
+    """The function's body, with nested function bodies excluded (they are
+    their own call-graph nodes and get analyzed separately)."""
+    return list(fn.body)
+
+
+def _called_names(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    stack: List[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue  # nested defs are separate nodes
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                out.add(node.func.attr)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def reachable_functions(trees: Sequence[ast.AST], roots: Iterable[str]) -> Set[str]:
+    edges: Dict[str, Set[str]] = {}
+    defined: Set[str] = set()
+    for tree in trees:
+        for fn in _function_nodes(tree):
+            defined.add(fn.name)
+            edges.setdefault(fn.name, set()).update(_called_names(fn))
+    seen: Set[str] = set()
+    frontier = [r for r in roots if r in defined]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for callee in edges.get(name, ()):
+            if callee in defined and callee not in seen:
+                frontier.append(callee)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# per-function analysis: taint (host-sync), bucketing, donation
+# ---------------------------------------------------------------------------
+
+class _FunctionAnalyzer:
+
+    def __init__(self, fn, path: str, lines: List[str], *, reachable: bool,
+                 module_donations: Dict[str, Tuple[int, ...]]):
+        self.fn = fn
+        self.path = path
+        self.lines = lines
+        self.reachable = reachable
+        self.findings: List[Finding] = []
+        self.tainted: Set[str] = set()           # names holding device values
+        self.jit_fns: Dict[str, Tuple[int, ...]] = {}  # local names bound to jitted programs
+        self.bucketed: Set[str] = set()          # names safe to shape jit inputs with
+        self.donations = dict(module_donations)  # name -> donated positions
+        self.dead: Dict[str, Tuple[int, str]] = {}  # donated root -> (line, callee)
+        for arg in self._all_args(fn):
+            self.bucketed.add(arg)
+
+    @staticmethod
+    def _all_args(fn) -> List[str]:
+        a = fn.args
+        args = list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        names = [x.arg for x in args]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    # ---------------------------------------------------- sanction comments
+    def _sanctioned(self, node: ast.AST, check: str) -> bool:
+        token = SANCTIONS.get(check)
+        if token is None:
+            return False
+        lo = getattr(node, "lineno", 0)
+        hi = getattr(node, "end_lineno", lo) or lo
+        for ln in range(lo, hi + 1):
+            if 1 <= ln <= len(self.lines) and token in self.lines[ln - 1]:
+                return True
+        return False
+
+    def _flag(self, node: ast.AST, check: str, message: str) -> None:
+        if self._sanctioned(node, check):
+            return
+        self.findings.append(Finding(self.path, getattr(node, "lineno", 0), check, message))
+
+    # ---------------------------------------------------- expression taint
+    def _host_convert_kind(self, call: ast.Call) -> Optional[str]:
+        func = call.func
+        d = _dotted(func)
+        if d in ("np.asarray", "np.array", "np.stack", "np.concatenate",
+                 "numpy.asarray", "numpy.array", "numpy.stack", "numpy.concatenate"):
+            return "np"
+        if isinstance(func, ast.Name) and func.id in ("int", "float", "bool"):
+            return "scalar"
+        if d in ("jax.device_get", "device_get"):
+            return "device_get"
+        if isinstance(func, ast.Attribute) and func.attr in ("item", "tolist"):
+            return "method"
+        if isinstance(func, ast.Attribute) and func.attr == "block_until_ready":
+            return "block"
+        return None
+
+    def _is_device_call(self, call: ast.Call) -> bool:
+        func = call.func
+        d = _dotted(func)
+        if d.startswith(DEVICE_CALL_PREFIXES) or d in ("jax.device_put",):
+            return True
+        if isinstance(func, ast.Attribute):
+            if func.attr in JIT_CALLEE_ATTRS or func.attr == "_choose_tokens_dev":
+                return True
+            # method call on a device value (x.reshape(...), x.astype(...))
+            if func.attr not in ("item", "tolist", "block_until_ready") \
+                    and self._expr_device(func.value):
+                return True
+        if isinstance(func, ast.Call) and isinstance(func.func, ast.Attribute) \
+                and func.func.attr in JIT_FACTORY_ATTRS:
+            return True  # self._fused_for(...)(...)
+        if isinstance(func, ast.Name) and func.id in self.jit_fns:
+            return True
+        return False
+
+    def _expr_device(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Attribute):
+            if node.attr in META_ATTRS:
+                return node.attr == "at" and self._expr_device(node.value)
+            if node.attr in DEVICE_SELF_ATTRS:
+                return True
+            return self._expr_device(node.value)
+        if isinstance(node, ast.Subscript):
+            return self._expr_device(node.value)
+        if isinstance(node, ast.Call):
+            if self._host_convert_kind(node) is not None:
+                return False  # produces a host value
+            return self._is_device_call(node)
+        if isinstance(node, (ast.BinOp,)):
+            return self._expr_device(node.left) or self._expr_device(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._expr_device(node.operand)
+        if isinstance(node, ast.IfExp):
+            return self._expr_device(node.body) or self._expr_device(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._expr_device(e) for e in node.elts)
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            if self._expr_device(node.elt):
+                return True
+            return any(self._expr_device(g.iter) for g in node.generators)
+        if isinstance(node, ast.Starred):
+            return self._expr_device(node.value)
+        return False
+
+    # ---------------------------------------------------- sink detection
+    def _check_sync_sinks(self, node: ast.AST) -> None:
+        """host-sync findings for every Call in an expression tree."""
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            kind = self._host_convert_kind(call)
+            if kind is None:
+                continue
+            if kind == "device_get":
+                self._flag(call, "host-sync",
+                           "explicit device readback (jax.device_get) on the hot path; "
+                           "bless intended readback points with '# graft-lint: readback'")
+            elif kind == "block":
+                self._flag(call, "host-sync",
+                           "block_until_ready() stalls the dispatch pipeline on the hot path")
+            elif kind == "np" and any(self._expr_device(a) for a in call.args):
+                self._flag(call, "host-sync",
+                           f"{_dotted(call.func)}() on a device value is an implicit "
+                           "device-to-host sync; use jax.device_get at a blessed "
+                           "'# graft-lint: readback' point")
+            elif kind == "scalar" and any(self._expr_device(a) for a in call.args):
+                self._flag(call, "host-sync",
+                           f"{call.func.id}() on a device value blocks on a "  # type: ignore[union-attr]
+                           "device-to-host transfer; read back explicitly first")
+            elif kind == "method" and isinstance(call.func, ast.Attribute) \
+                    and self._expr_device(call.func.value):
+                self._flag(call, "host-sync",
+                           f".{call.func.attr}() on a device value is an implicit "
+                           "device-to-host sync")
+
+    def _bucketed_expr(self, node: Optional[ast.AST]) -> bool:
+        """True when a shape/bound expression cannot churn compiles: consts,
+        bucketing-helper results, and arithmetic over those."""
+        if node is None:
+            return True
+        if isinstance(node, ast.Constant):
+            return True
+        if isinstance(node, ast.Name):
+            return node.id in self.bucketed
+        if isinstance(node, ast.Attribute):
+            return True  # config attributes are session constants
+        if isinstance(node, ast.BinOp):
+            return self._bucketed_expr(node.left) and self._bucketed_expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._bucketed_expr(node.operand)
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else "")
+            return name in BUCKET_HELPERS or name in ("min", "max") and all(
+                self._bucketed_expr(a) for a in node.args)
+        return False
+
+    def _check_recompile(self, node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Subscript) and isinstance(sub.value, ast.Attribute) \
+                    and sub.value.attr == "at":
+                dims = sub.slice.elts if isinstance(sub.slice, ast.Tuple) else [sub.slice]
+                for dim in dims:
+                    if not isinstance(dim, ast.Slice):
+                        continue
+                    for bound in (dim.lower, dim.upper):
+                        if bound is not None and not self._bucketed_expr(bound):
+                            src = ast.unparse(bound)
+                            self._flag(sub, "jit-recompile",
+                                       f".at[] slice bound '{src}' is a raw Python int: "
+                                       "one compiled program per distinct value; route it "
+                                       "through _next_pow2/_decode_bucket/_fused_bucket")
+            if isinstance(sub, ast.Call):
+                d = _dotted(sub.func)
+                if d in ("jnp.stack", "jnp.concatenate", "jnp.array", "jnp.asarray") \
+                        and sub.args and isinstance(sub.args[0], (ast.ListComp, ast.GeneratorExp)):
+                    self._flag(sub, "jit-recompile",
+                               f"{d}() over a dynamically-sized Python list retraces per "
+                               "length; pad the list to a bucketed size first")
+
+    # ---------------------------------------------------- donation tracking
+    def _donated_positions(self, call: ast.Call) -> Tuple[Tuple[int, ...], str]:
+        func = call.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in JIT_CALLEE_ATTRS:
+                return JIT_CALLEE_ATTRS[func.attr], func.attr
+            if func.attr in self.donations:
+                return self.donations[func.attr], func.attr
+        if isinstance(func, ast.Name):
+            if func.id in self.jit_fns:
+                return self.jit_fns[func.id], func.id
+            if func.id in self.donations:
+                return self.donations[func.id], func.id
+        if isinstance(func, ast.Call) and isinstance(func.func, ast.Attribute) \
+                and func.func.attr in JIT_FACTORY_ATTRS:
+            return JIT_FACTORY_ATTRS[func.func.attr], func.func.attr
+        return (), ""
+
+    @staticmethod
+    def _root_of(node: ast.AST) -> Optional[str]:
+        """'x' for Name x, 'self.k_pages' for a plain attribute chain."""
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            d = _dotted(node)
+            return d or None
+        return None
+
+    def _assign_targets(self, target: ast.AST) -> List[str]:
+        out: List[str] = []
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                out.extend(self._assign_targets(e))
+        else:
+            r = self._root_of(target)
+            if r is not None:
+                out.append(r)
+        return out
+
+    def _check_donations(self, stmt: ast.AST, rebound: List[str]) -> None:
+        # 1) uses of already-dead (donated, un-rebound) roots in this stmt
+        for node in ast.walk(stmt):
+            root = None
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                root = node.id
+            elif isinstance(node, ast.Attribute) and isinstance(node.ctx, ast.Load):
+                root = _dotted(node)
+            if root and root in self.dead:
+                line, callee = self.dead.pop(root)
+                self._flag(node, "donated-reuse",
+                           f"'{root}' was donated to {callee}() at line {line} and its "
+                           "buffer is gone; rebind the call's result instead")
+        # 2) new donating calls in this stmt
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            positions, callee = self._donated_positions(call)
+            for pos in positions:
+                if pos >= len(call.args):
+                    continue
+                root = self._root_of(call.args[pos])
+                if root is None or root in rebound:
+                    continue
+                if self._sanctioned(call, "donated-reuse"):
+                    continue
+                self.dead[root] = (call.lineno, callee)
+        # rebinding revives a root
+        for r in rebound:
+            self.dead.pop(r, None)
+
+    # ---------------------------------------------------- statement walk
+    def run(self) -> List[Finding]:
+        self._walk_body(_own_statements(self.fn))
+        return self.findings
+
+    def _walk_body(self, body: Sequence[ast.stmt]) -> None:
+        for stmt in body:
+            self._visit_stmt(stmt)
+
+    def _visit_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # separate call-graph node
+        rebound: List[str] = []
+        if isinstance(stmt, ast.Assign):
+            for t in stmt.targets:
+                rebound.extend(self._assign_targets(t))
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) and stmt.value is not None:
+            rebound.extend(self._assign_targets(stmt.target))
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            rebound.extend(self._assign_targets(stmt.target))
+        elif isinstance(stmt, ast.With):
+            for item in stmt.items:
+                if item.optional_vars is not None:
+                    rebound.extend(self._assign_targets(item.optional_vars))
+
+        compound = isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor, ast.With, ast.Try))
+        scan = self._stmt_header(stmt) if compound else stmt
+        if self.reachable:
+            self._check_sync_sinks(scan)
+            self._check_recompile(scan)
+        self._check_donations(scan, rebound)
+        self._update_taint(stmt)
+        self._update_buckets(stmt)
+
+        # descend into compound statements in source order
+        for attr in ("body", "orelse", "finalbody"):
+            sub = getattr(stmt, attr, None)
+            if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                self._walk_body(sub)
+        for handler in getattr(stmt, "handlers", []) or []:
+            self._walk_body(handler.body)
+
+    @staticmethod
+    def _stmt_header(stmt: ast.stmt) -> ast.AST:
+        """For compound statements only the header expression belongs to this
+        visit (bodies are visited as their own statements)."""
+        mod = ast.Module(body=[], type_ignores=[])
+        header = getattr(stmt, "test", None) or getattr(stmt, "iter", None)
+        if header is None and isinstance(stmt, ast.With):
+            mod.body = [ast.Expr(value=i.context_expr) for i in stmt.items]  # type: ignore[list-item]
+            return mod
+        if header is not None:
+            mod.body = [ast.Expr(value=header)]  # type: ignore[list-item]
+        return mod
+
+    def _update_taint(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Assign) and stmt.value is not None:
+            self._taint_assign(stmt.targets, stmt.value)
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) and stmt.value is not None:
+            self._taint_assign([stmt.target], stmt.value)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            # iterating a device container taints the loop targets
+            device = self._expr_device(stmt.iter)
+            for name in self._assign_targets(stmt.target):
+                if "." in name:
+                    continue
+                (self.tainted.add if device else self.tainted.discard)(name)
+
+    def _taint_assign(self, targets: Sequence[ast.AST], value: ast.AST) -> None:
+        # track jitted-program bindings: fn = self._fused_for(...)
+        if isinstance(value, ast.Call) and isinstance(value.func, ast.Attribute) \
+                and value.func.attr in JIT_FACTORY_ATTRS:
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.jit_fns[t.id] = JIT_FACTORY_ATTRS[value.func.attr]
+            return
+        # track jax.jit(..., donate_argnums=...) bindings
+        if isinstance(value, ast.Call) and _dotted(value.func) in ("jax.jit",):
+            donated = ()
+            for kw in value.keywords:
+                if kw.arg == "donate_argnums":
+                    donated = _const_int_tuple(kw.value)
+            for t in targets:
+                r = self._root_of(t)
+                if r is not None and donated:
+                    self.donations[r.rsplit(".", 1)[-1]] = donated
+        device = self._expr_device(value)
+        for t in targets:
+            for name in self._assign_targets(t):
+                if "." in name:
+                    continue  # attributes: only DEVICE_SELF_ATTRS matter, fixed set
+                (self.tainted.add if device else self.tainted.discard)(name)
+
+    def _update_buckets(self, stmt: ast.stmt) -> None:
+        if not isinstance(stmt, ast.Assign) or stmt.value is None:
+            return
+        if self._bucketed_expr(stmt.value):
+            for t in stmt.targets:
+                for name in self._assign_targets(t):
+                    if "." not in name:
+                        self.bucketed.add(name)
+        else:
+            for t in stmt.targets:
+                for name in self._assign_targets(t):
+                    self.bucketed.discard(name)
+
+
+def _const_int_tuple(node: ast.AST) -> Tuple[int, ...]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+        return tuple(out)
+    return ()
+
+
+# ---------------------------------------------------------------------------
+# module-level checks
+# ---------------------------------------------------------------------------
+
+def _module_donations(tree: ast.AST) -> Dict[str, Tuple[int, ...]]:
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                and _dotted(node.value.func) == "jax.jit":
+            donated: Tuple[int, ...] = ()
+            for kw in node.value.keywords:
+                if kw.arg == "donate_argnums":
+                    donated = _const_int_tuple(kw.value)
+            if donated:
+                for t in node.targets:
+                    r = _FunctionAnalyzer._root_of(t)
+                    if r is not None:
+                        out[r.rsplit(".", 1)[-1]] = donated
+    return out
+
+
+def _check_knobs(tree: ast.AST, path: str, declared: Set[str], prefixes: Set[str],
+                 is_registry_module: bool) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def handle(node: ast.AST, name: Optional[str], via_registry: bool) -> None:
+        if name is None or not name.startswith(ENV_PREFIX):
+            return
+        declared_ok = name in declared or any(name.startswith(p) for p in prefixes)
+        if not via_registry and not is_registry_module:
+            findings.append(Finding(path, node.lineno, "knob",
+                                    f"env read of {name} outside analysis/knobs.py; "
+                                    "use deepspeed_tpu.analysis.knobs.get_*"))
+        if not declared_ok:
+            findings.append(Finding(path, node.lineno, "knob",
+                                    f"{name} is not declared in analysis/knobs.py"))
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            d = _dotted(node.func)
+            if d in ("os.environ.get", "os.getenv", "environ.get", "getenv") and node.args:
+                arg = node.args[0]
+                handle(node, _str_const(arg) or _fstring_prefix(arg), via_registry=False)
+            elif d.split(".")[-1] in ("get_str", "get_int", "get_float", "get_bool", "is_set") \
+                    and "knobs" in d and node.args:
+                arg = node.args[0]
+                handle(node, _str_const(arg) or _fstring_prefix(arg), via_registry=True)
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx, ast.Load) \
+                and _dotted(node.value) == "os.environ":
+            handle(node, _str_const(node.slice) or _fstring_prefix(node.slice),
+                   via_registry=False)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str], *, roots: Sequence[str] = HOT_ROOTS,
+               knobs_path: Optional[str] = None) -> List[Finding]:
+    files = _iter_py_files(paths)
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.AST] = {}
+    findings: List[Finding] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            trees[f] = ast.parse(src, filename=f)
+        except SyntaxError as e:
+            findings.append(Finding(f, e.lineno or 0, "parse", f"syntax error: {e.msg}"))
+            continue
+        sources[f] = src
+
+    if knobs_path is None:
+        for f in files:
+            if f.replace(os.sep, "/").endswith("analysis/knobs.py"):
+                knobs_path = f
+                break
+    declared: Set[str] = set()
+    prefixes: Set[str] = set()
+    if knobs_path is not None and os.path.exists(knobs_path):
+        declared, prefixes = load_declared_knobs(knobs_path)
+
+    reachable = reachable_functions(list(trees.values()), roots)
+    for f, tree in trees.items():
+        findings.extend(
+            lint_tree(tree, f, sources[f], reachable=reachable,
+                      declared_knobs=declared, knob_prefixes=prefixes,
+                      is_registry_module=f.replace(os.sep, "/").endswith("analysis/knobs.py")))
+    findings.sort(key=lambda x: (x.path, x.line, x.check))
+    return findings
+
+
+def lint_tree(tree: ast.AST, path: str, source: str, *, reachable: Set[str],
+              declared_knobs: Set[str], knob_prefixes: Set[str],
+              is_registry_module: bool = False) -> List[Finding]:
+    lines = source.splitlines()
+    findings = _check_knobs(tree, path, declared_knobs, knob_prefixes, is_registry_module)
+    donations = _module_donations(tree)
+    for fn in _function_nodes(tree):
+        analyzer = _FunctionAnalyzer(fn, path, lines, reachable=fn.name in reachable,
+                                     module_donations=donations)
+        findings.extend(analyzer.run())
+    return findings
+
+
+def lint_source(source: str, path: str = "<string>", *, roots: Sequence[str] = HOT_ROOTS,
+                declared_knobs: Iterable[str] = (), knob_prefixes: Iterable[str] = ()) -> List[Finding]:
+    """Single-source entry point used by the fixture unit tests."""
+    tree = ast.parse(source, filename=path)
+    reachable = reachable_functions([tree], roots)
+    out = lint_tree(tree, path, source, reachable=reachable,
+                    declared_knobs=set(declared_knobs), knob_prefixes=set(knob_prefixes))
+    out.sort(key=lambda x: (x.path, x.line, x.check))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline / suppression file
+# ---------------------------------------------------------------------------
+
+def load_baseline(path: str) -> Set[Tuple[str, str, str]]:
+    """Baseline entries: (relpath, check, stripped source line). Line numbers
+    are deliberately not part of the key so unrelated edits don't churn it."""
+    out: Set[Tuple[str, str, str]] = set()
+    if not os.path.exists(path):
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        for raw in f:
+            raw = raw.strip()
+            if not raw or raw.startswith("#"):
+                continue
+            parts = raw.split("|", 2)
+            if len(parts) == 3:
+                out.add((parts[0], parts[1], parts[2]))
+    return out
+
+
+def baseline_key(finding: Finding, sources: Dict[str, List[str]]) -> Tuple[str, str, str]:
+    lines = sources.get(finding.path, [])
+    text = lines[finding.line - 1].strip() if 0 < finding.line <= len(lines) else ""
+    return (finding.path.replace(os.sep, "/"), finding.check, text)

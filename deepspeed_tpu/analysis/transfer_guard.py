@@ -1,0 +1,40 @@
+"""Transfer-guard scoping for the serving hot path.
+
+``no_implicit_host_transfers()`` wraps a block in
+``jax.transfer_guard_device_to_host("disallow")``: any *implicit*
+device→host readback (``np.asarray`` on a device array, ``float()``,
+``print``, comparisons forcing a concrete value, …) raises instead of
+silently stalling the dispatch pipeline. Explicit ``jax.device_get``
+calls — the blessed, ``# graft-lint: readback``-sanctioned readback
+points — stay allowed, which is exactly the contract graft-lint's
+``host-sync`` check enforces statically.
+
+The engine scopes its serving loops with this when ``DS_TPU_TRANSFER_GUARD``
+is set; the fused/spec parity tests run under it permanently.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager, nullcontext
+
+import jax
+
+
+def no_implicit_host_transfers():
+    """Context manager disallowing implicit device→host transfers (explicit
+    ``jax.device_get`` remains allowed). Falls back to a no-op on jax
+    versions without transfer guards."""
+    guard = getattr(jax, "transfer_guard_device_to_host", None)
+    if guard is None:
+        return nullcontext()
+    return guard("disallow")
+
+
+@contextmanager
+def maybe_guard(enabled: bool):
+    """``no_implicit_host_transfers()`` when ``enabled``, else a no-op."""
+    if not enabled:
+        yield
+        return
+    with no_implicit_host_transfers():
+        yield

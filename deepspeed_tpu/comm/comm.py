@@ -25,6 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..analysis import knobs
 from ..telemetry.registry import get_registry
 from ..utils.comms_logging import CommsLogger, get_caller_func
 from ..utils.logging import logger
@@ -55,9 +56,9 @@ def init_distributed(dist_backend: str = "xla", auto_mpi_discovery: bool = True,
     if _INITIALIZED:
         return
 
-    coordinator = os.environ.get(DS_COMM_ENV_COORDINATOR)
-    nprocs = int(os.environ.get(DS_COMM_ENV_NUM_PROCESSES, world_size if world_size > 0 else 1))
-    proc_id = int(os.environ.get(DS_COMM_ENV_PROCESS_ID, rank if rank >= 0 else 0))
+    coordinator = knobs.get_str(DS_COMM_ENV_COORDINATOR)
+    nprocs = knobs.get_int(DS_COMM_ENV_NUM_PROCESSES, world_size if world_size > 0 else 1)
+    proc_id = knobs.get_int(DS_COMM_ENV_PROCESS_ID, rank if rank >= 0 else 0)
 
     if coordinator is None and os.environ.get("MASTER_ADDR"):
         coordinator = f"{os.environ['MASTER_ADDR']}:{os.environ.get('MASTER_PORT', '29500')}"

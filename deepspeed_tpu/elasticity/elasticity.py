@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..analysis import knobs
 from ..runtime.config import ElasticityConfig
 from ..utils.logging import logger
 from ..version import __version__
@@ -241,7 +242,7 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = __ve
         if current == 0:
             # only DS_TPU_WORLD_CHIPS counts chips; WORLD_SIZE is the process
             # (host) count under one-proc-per-host and must not be trusted here
-            env = os.getenv("DS_TPU_WORLD_CHIPS", "")
+            env = knobs.get_str("DS_TPU_WORLD_CHIPS", "")
             if not env.isnumeric():
                 raise ElasticityConfigError(
                     "elasticity v0.2 needs the total chip count: pass world_size or launch via ds_tpu "

@@ -16,7 +16,6 @@ paged-KV plumbing. TPU re-design:
 """
 
 import dataclasses
-import os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -24,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ...analysis import knobs
+from ...analysis.transfer_guard import maybe_guard
 from ...models.transformer import TransformerConfig
 from ...telemetry import get_registry as get_telemetry_registry
 from ...telemetry import span as telemetry_span
@@ -172,6 +173,7 @@ class InferenceEngineV2:
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
         assert self._garbage_block == 0
+        self.state.register_sanitizer_root(self._garbage_block)
 
         L, bs = cfg.n_layers, smc.kv_block_size
         self.k_pages = jnp.zeros((L, n_blocks, bs, cfg.kv_heads, cfg.head_dim), self.dtype)
@@ -205,20 +207,31 @@ class InferenceEngineV2:
         run_mesh = self._mesh_topo.mesh if self._mesh_topo is not None else None
         self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp)
         self._run_cfg, self._interpret, self._run_mesh = run_cfg, interpret, run_mesh
+        # runtime sanitizers (analysis/, all off by default): recompile audit
+        # wraps every jitted serving program; the transfer guard scopes the
+        # serving loops so implicit device->host syncs raise
+        self.jit_auditor = None
+        if knobs.get_bool("DS_TPU_JIT_AUDIT"):
+            from ...analysis.jit_audit import JitAuditor
+
+            self.jit_auditor = JitAuditor(monitor=self._health)
+            self._prefill_fn = self.jit_auditor.wrap("prefill", self._prefill_fn)
+            self._decode_fn = self.jit_auditor.wrap("decode", self._decode_fn)
+        self._guard_enabled = knobs.get_bool("DS_TPU_TRANSFER_GUARD")
         self._bursts: Dict[tuple, object] = {}  # sampling signature -> jitted burst
         self._fused_fns: Dict[tuple, object] = {}  # (bucket shape, sampling) -> jitted fused step
         self._cow_fn = None  # lazily-jitted donated page copy for copy-on-write
         fused = config.fused_step
         if fused is None:
-            fused = os.environ.get("DS_TPU_SERVE_FUSED", "1") != "0"
+            fused = knobs.get_bool("DS_TPU_SERVE_FUSED")
         self._fused_enabled = bool(fused)
         spec = config.spec_decode
         if spec is None:
-            spec = os.environ.get("DS_TPU_SPEC_DECODE", "0") != "0"
+            spec = knobs.get_bool("DS_TPU_SPEC_DECODE")
         self._spec_enabled = bool(spec)
         spec_k = config.spec_k
         if spec_k is None:
-            spec_k = int(os.environ.get("DS_TPU_SPEC_K", "4") or 4)
+            spec_k = knobs.get_int("DS_TPU_SPEC_K")
         self._spec_k = max(1, int(spec_k))
         self._drafter = make_drafter(config.spec_drafter)
         self._spec_fns: Dict[tuple, object] = {}  # (chunk, sampling) -> jitted verify
@@ -245,8 +258,11 @@ class InferenceEngineV2:
             if len(self._bursts) >= self._MAX_BURST_VARIANTS:
                 self._bursts.pop(next(iter(self._bursts)))
             do, t, k, p = key
-            self._bursts[key] = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
-                                              tp=self._tp, do_sample=do, temperature=t, top_k=k, top_p=p)
+            fn = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
+                               tp=self._tp, do_sample=do, temperature=t, top_k=k, top_p=p)
+            if self.jit_auditor is not None:
+                fn = self.jit_auditor.wrap(f"burst{key}", fn)
+            self._bursts[key] = fn
         else:
             # LRU touch: keep a hot signature (e.g. greedy) from being
             # evicted by a frontend cycling through >8 sampling configs
@@ -267,7 +283,8 @@ class InferenceEngineV2:
         return sample_logits(logits, r, do, t, k, p)
 
     def _choose_tokens(self, logits) -> np.ndarray:
-        return np.asarray(self._choose_tokens_dev(logits))
+        # the serving loop's per-step token fetch: B ints, not B*V logits
+        return jax.device_get(self._choose_tokens_dev(logits))  # graft-lint: readback
 
     # ---------------------------------------------------------- feasibility
     def query(self, uid: int, max_request_length: int) -> Tuple[int, int]:
@@ -374,6 +391,8 @@ class InferenceEngineV2:
             self._cow_fn = jax.jit(
                 lambda kp, vp, s, d: (kp.at[:, d].set(kp[:, s]), vp.at[:, d].set(vp[:, s])),
                 donate_argnums=(0, 1))
+            if self.jit_auditor is not None:
+                self._cow_fn = self.jit_auditor.wrap("cow_copy", self._cow_fn)
         self.k_pages, self.v_pages = self._cow_fn(self.k_pages, self.v_pages, src, dst)
 
     def _cow_ready(self, seq, start_pos: int) -> None:
@@ -418,6 +437,7 @@ class InferenceEngineV2:
             seq = self.state.get_or_create_sequence(uid)
             self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, len(tokens))
+            self.state.sanitize_write(seq, seq.seen_tokens, len(tokens))
             seq.record_tokens(tokens)
             seq.pre_forward(len(tokens))
             start, m = seq.seen_tokens, len(tokens)
@@ -446,7 +466,7 @@ class InferenceEngineV2:
         if return_tokens:
             out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         else:
-            out = np.asarray(logits[:n])
+            out = jax.device_get(logits[:n])  # graft-lint: readback (caller asked for host logits)
         return [out[j] for j in range(n)]
 
     def _decode_bucket(self, n: int) -> int:
@@ -473,6 +493,7 @@ class InferenceEngineV2:
             seq = self.state.get_sequence(uid)
             self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, steps)
+            self.state.sanitize_write(seq, seq.seen_tokens, steps)
             seq.record_tokens(None)  # decode ids may be device-side: freeze the log
             seq.pre_forward(steps)
             pos0 = seq.seen_tokens
@@ -491,8 +512,12 @@ class InferenceEngineV2:
         stack + pad that never touches the host (the deferred serving
         loop's replacement for the ``ids[j, 0] = int(tok)`` host write)."""
         n = len(carried)
-        col = jnp.stack([jnp.asarray(t, jnp.int32).reshape(()) for t in carried])
-        return jnp.zeros((B, 1), jnp.int32).at[:n, 0].set(col)
+        # pad the scalar list to the bucket BEFORE stacking: the stacked shape
+        # (and the whole eager op chain) then depends only on B, not on n —
+        # per-n shapes were a one-program-per-batch-size compile ladder
+        col = [jnp.asarray(t, jnp.int32).reshape(()) for t in carried]
+        col.extend([jnp.zeros((), jnp.int32)] * (B - n))  # padded rows feed the garbage page
+        return jnp.stack(col).reshape(B, 1)
 
     def _run_decode(self, uids: List[int], tokens: List[int], return_tokens: bool = False,
                     ids_dev=None, defer: bool = False):
@@ -517,7 +542,7 @@ class InferenceEngineV2:
             return self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
         if return_tokens:
             return self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
-        return np.asarray(logits[:n])
+        return jax.device_get(logits[:n])  # graft-lint: readback (caller asked for host logits)
 
     def _burst_steps(self, live: Dict[int, int], remaining: int) -> int:
         """Largest power-of-two burst length every live sequence can take.
@@ -564,7 +589,7 @@ class InferenceEngineV2:
             seq.post_forward()
         if defer:
             return toks[:n]  # device (n, steps), no readback
-        return np.asarray(toks[:n])
+        return jax.device_get(toks[:n])  # graft-lint: readback (n*steps ints, the burst's one fetch)
 
     # ---------------------------------------------------------- fused quantum
     def _fused_bucket(self, n_dec: int, n_pre: int, max_chunk: int) -> Tuple[int, int, int]:
@@ -599,10 +624,13 @@ class InferenceEngineV2:
             if len(self._fused_fns) >= self._MAX_FUSED_VARIANTS:
                 self._fused_fns.pop(next(iter(self._fused_fns)))
             do, t, k, p = key[3:]
-            self._fused_fns[key] = make_fused_step_fn(self._run_cfg, interpret=self._interpret,
-                                                      mesh=self._run_mesh, tp=self._tp,
-                                                      n_dec=n_dec, n_pre=n_pre, chunk=chunk,
-                                                      do_sample=do, temperature=t, top_k=k, top_p=p)
+            fn = make_fused_step_fn(self._run_cfg, interpret=self._interpret,
+                                    mesh=self._run_mesh, tp=self._tp,
+                                    n_dec=n_dec, n_pre=n_pre, chunk=chunk,
+                                    do_sample=do, temperature=t, top_k=k, top_p=p)
+            if self.jit_auditor is not None:
+                fn = self.jit_auditor.wrap(f"fused{key}", fn)
+            self._fused_fns[key] = fn
         else:
             self._fused_fns[key] = self._fused_fns.pop(key)  # LRU touch
         return self._fused_fns[key]
@@ -668,6 +696,7 @@ class InferenceEngineV2:
             seq = self.state.get_sequence(uid)
             self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, steps)
+            self.state.sanitize_write(seq, seq.seen_tokens, steps)
             seq.record_tokens(None)  # decode ids may be device-side: freeze the log
             seq.pre_forward(steps)
             pos0 = seq.seen_tokens
@@ -689,6 +718,7 @@ class InferenceEngineV2:
             m = len(pf.tokens)
             self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, m)
+            self.state.sanitize_write(seq, seq.seen_tokens, m)
             seq.record_tokens(pf.tokens)
             seq.pre_forward(m)
             start = seq.seen_tokens
@@ -706,9 +736,12 @@ class InferenceEngineV2:
         ids_dev = jnp.asarray(ids)
         if n_dec and defer:
             # device token scalars from the previous quantum stack into the
-            # decode segment without a host sync
-            col = jnp.stack([jnp.asarray(t, jnp.int32).reshape(()) for t in decode_carry])
-            ids_dev = ids_dev.at[:n_dec].set(col)
+            # decode segment without a host sync; the list pads to the decode
+            # bucket D so the stack/set shapes never depend on the raw row
+            # count (per-n_dec shapes were a compile ladder)
+            col = [jnp.asarray(t, jnp.int32).reshape(()) for t in decode_carry]
+            col.extend([jnp.zeros((), jnp.int32)] * (D - n_dec))  # padded rows feed the garbage page
+            ids_dev = ids_dev.at[:D].set(jnp.stack(col))
 
         fn = self._fused_for(D, P, S, self._sampling)
         self._rng, rng = jax.random.split(self._rng)
@@ -735,12 +768,15 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
 
+        # non-deferred mode fetches the quantum's sampled tokens in ONE
+        # readback (N*steps ints) instead of one tiny transfer per row
+        toks_host = None if defer else jax.device_get(toks)  # graft-lint: readback
         out: Dict[int, object] = {}
         for j, uid in enumerate(dec_uids):
-            out[uid] = toks[j] if defer else np.asarray(toks[j])
+            out[uid] = toks[j] if defer else toks_host[j]
         for r, pf in enumerate(prefills):
             if pf.final:
-                out[pf.uid] = toks[D + r] if defer else np.asarray(toks[D + r])
+                out[pf.uid] = toks[D + r] if defer else toks_host[D + r]
             else:
                 out[pf.uid] = None
         return out
@@ -758,9 +794,12 @@ class InferenceEngineV2:
             if len(self._spec_fns) >= self._MAX_SPEC_VARIANTS:
                 self._spec_fns.pop(next(iter(self._spec_fns)))
             do, t, k, p = key[1:]
-            self._spec_fns[key] = make_spec_verify_fn(self._run_cfg, interpret=self._interpret,
-                                                      mesh=self._run_mesh, tp=self._tp, chunk=chunk,
-                                                      do_sample=do, temperature=t, top_k=k, top_p=p)
+            fn = make_spec_verify_fn(self._run_cfg, interpret=self._interpret,
+                                     mesh=self._run_mesh, tp=self._tp, chunk=chunk,
+                                     do_sample=do, temperature=t, top_k=k, top_p=p)
+            if self.jit_auditor is not None:
+                fn = self.jit_auditor.wrap(f"spec{key}", fn)
+            self._spec_fns[key] = fn
         else:
             self._spec_fns[key] = self._spec_fns.pop(key)  # LRU touch
         return self._spec_fns[key]
@@ -817,6 +856,7 @@ class InferenceEngineV2:
             seq = self.state.get_sequence(uid)
             self._cow_ready(seq, seq.seen_tokens)
             self.state.allocate_for(seq, chunk)
+            self.state.sanitize_write(seq, seq.seen_tokens, chunk)
             seq.record_tokens(None)  # committed tokens are resolved post-verify
             seq.pre_forward(chunk)
             pos0 = seq.seen_tokens
@@ -843,8 +883,8 @@ class InferenceEngineV2:
         self._m_dispatches.inc()
         self._m_decode_steps.inc()
         self._m_decode_fill.set(n / B)
-        committed = np.asarray(committed)  # (B, chunk) ints + (B,) counts: the
-        accepted = np.asarray(accepted)    # whole readback for up to B*chunk tokens
+        # (B, chunk) ids + (B,) counts: the whole readback for up to B*chunk tokens
+        committed, accepted = jax.device_get((committed, accepted))  # graft-lint: readback
         for seq in seqs:
             seq.post_forward()
 
@@ -898,7 +938,8 @@ class InferenceEngineV2:
             for i, p in enumerate(prompts):
                 self._events.emit("enqueue", i, prompt=len(p))
         try:
-            return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
+            with maybe_guard(self._guard_enabled):
+                return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
         finally:
             self._sampling = None
 
@@ -960,9 +1001,9 @@ class InferenceEngineV2:
         rows = [jnp.concatenate(pieces[i]) if len(pieces[i]) > 1 else pieces[i][0] for i in range(len(prompts))]
         lens = {int(r.shape[0]) for r in rows}
         if len(lens) == 1:
-            arr = np.asarray(jnp.stack(rows))
+            arr = jax.device_get(jnp.stack(rows))  # graft-lint: readback (the generate's ONE fetch)
             return [arr[i].tolist() for i in range(len(prompts))]
-        return [np.asarray(r).tolist() for r in rows]
+        return [jax.device_get(r).tolist() for r in rows]  # graft-lint: readback (ragged final fetch)
 
     def _generate(self, prompts, max_new_tokens, eos_token_id, on_token=None) -> List[List[int]]:
         if self._fused_enabled:

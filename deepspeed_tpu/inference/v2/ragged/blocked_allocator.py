@@ -31,6 +31,10 @@ class BlockedAllocator:
         self._free_set = set(self._free)  # O(1) membership for the double-free check
         self._refcount = [0] * num_blocks
         self._evict_hook: Optional[Callable[[int], None]] = None
+        # optional shadow-refcount sanitizer (analysis/kv_sanitizer.py):
+        # mirrors every allocate/retain/release and traps invariant breaks
+        # BEFORE this allocator mutates, so the two tables stay in lockstep
+        self._sanitizer = None
 
     @property
     def total_blocks(self) -> int:
@@ -42,6 +46,10 @@ class BlockedAllocator:
 
     def refcount(self, block: int) -> int:
         return self._refcount[block]
+
+    def set_sanitizer(self, sanitizer) -> None:
+        """Install a ``ShadowRefcounts`` mirror (``DS_TPU_KV_SANITIZE``)."""
+        self._sanitizer = sanitizer
 
     def set_eviction_hook(self, hook: Optional[Callable[[int], None]]) -> None:
         """``hook(shortfall)`` is called when ``allocate`` is short by
@@ -64,11 +72,15 @@ class BlockedAllocator:
             self._free_set.discard(b)
             self._refcount[b] = 1
             out.append(b)
+        if self._sanitizer is not None:
+            self._sanitizer.on_allocate(out)
         return out
 
     def retain(self, blocks: Union[int, Iterable[int]]) -> None:
         """Add one holder to each block (it must be live)."""
         for b in ((blocks,) if isinstance(blocks, int) else blocks):
+            if self._sanitizer is not None:
+                self._sanitizer.on_retain(b)
             if self._refcount[b] <= 0:
                 raise ValueError(f"retain of unallocated block {b}")
             self._refcount[b] += 1
@@ -79,6 +91,8 @@ class BlockedAllocator:
         for b in blocks:
             if not (0 <= b < self._num_blocks):
                 raise ValueError(f"block id {b} out of range")
+            if self._sanitizer is not None:
+                self._sanitizer.on_release(b)
             if b in self._free_set or self._refcount[b] <= 0:
                 raise ValueError(f"double free of block {b}")
             self._refcount[b] -= 1

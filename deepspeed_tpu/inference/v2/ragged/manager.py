@@ -14,12 +14,12 @@ retiring sequences donate their block-aligned prefixes back to the tree
 copy-on-write (``ensure_writable``).
 """
 
-import os
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Set
 
 import numpy as np
 
+from ....analysis import knobs
 from ....telemetry import get_registry as get_telemetry_registry
 from ....telemetry import span as telemetry_span
 from ....telemetry.events import get_event_log
@@ -50,7 +50,16 @@ class DSStateManager:
         self._allocator = BlockedAllocator(num_kv_blocks)
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
         if enable_prefix_cache is None:
-            enable_prefix_cache = os.environ.get("DS_TPU_PREFIX_CACHE", "1") != "0"
+            enable_prefix_cache = knobs.get_bool("DS_TPU_PREFIX_CACHE")
+        # shadow-refcount sanitizer (DS_TPU_KV_SANITIZE): installed before
+        # any allocation so the shadow table sees every block's lifetime
+        self._sanitizer = None
+        self._sanitize_roots: Set[int] = set()  # engine-held blocks (garbage page)
+        if knobs.get_bool("DS_TPU_KV_SANITIZE"):
+            from ....analysis.kv_sanitizer import ShadowRefcounts
+
+            self._sanitizer = ShadowRefcounts()
+            self._allocator.set_sanitizer(self._sanitizer)
         self._prefix_cache: Optional[PrefixCache] = None
         if enable_prefix_cache:
             self._prefix_cache = PrefixCache(self._allocator, config.kv_block_size,
@@ -194,6 +203,39 @@ class DSStateManager:
     def can_allocate(self, num_blocks: int) -> bool:
         return num_blocks <= self.available_blocks
 
+    # ------------------------------------------------------ KV sanitizer
+    @property
+    def sanitizer(self):
+        return self._sanitizer
+
+    def register_sanitizer_root(self, block: int) -> None:
+        """Mark an engine-held block (the garbage page) as intentionally
+        reachable so the leak-at-flush check does not report it."""
+        self._sanitize_roots.add(block)
+
+    def sanitize_write(self, seq: DSSequenceDescriptor, start_pos: int,
+                       n_tokens: int) -> None:
+        """Trap an imminent KV write that would land in a shared block
+        (copy-on-write was skipped). No-op unless DS_TPU_KV_SANITIZE."""
+        if self._sanitizer is None:
+            return
+        self._sanitizer.check_write(seq.uid, seq.blocks, start_pos, n_tokens,
+                                    self.block_size, self._allocator.refcount)
+
+    def sanitize_verify(self) -> None:
+        """Full invariant sweep: shadow-vs-allocator drift plus the
+        leak check against everything reachable right now."""
+        if self._sanitizer is None:
+            return
+        self._sanitizer.verify_against(self._allocator._refcount)
+        reachable: Set[int] = set(self._sanitize_roots)
+        for seq in self._seqs.values():
+            reachable.update(seq.blocks)
+        if self._prefix_cache is not None:
+            reachable.update(n.block for n in self._prefix_cache._iter_nodes())
+        allocated = [b for b, rc in enumerate(self._allocator._refcount) if rc > 0]
+        self._sanitizer.check_leaks(allocated, reachable)
+
     def block_table_row(self, seq: Optional[DSSequenceDescriptor], width: int,
                         fill_block: int = 0) -> np.ndarray:
         """Fixed-width block-table row for a (possibly mixed/fused) batch:
@@ -255,6 +297,9 @@ class DSStateManager:
         # re-sync unconditionally: back-to-back SLA runs reset through
         # here, and an empty tracker must not leave stale gauges behind
         self._sync_gauges()
+        # with everything retired, any allocated block not reachable from
+        # the cache tree or a registered root has leaked for good
+        self.sanitize_verify()
 
     def reset_prefix_cache(self) -> int:
         """Drop every evictable cached prefix (A/B runs, tests). Returns

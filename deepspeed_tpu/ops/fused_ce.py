@@ -21,14 +21,15 @@ accumulated in fp32 across chunks and cast to ``w.dtype`` once at the end.
 """
 
 import functools
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-_CHUNK_TARGET = int(os.environ.get("DS_TPU_CE_CHUNK", 0))  # 0 = auto (memory-budgeted)
-_BUDGET_MB = int(os.environ.get("DS_TPU_CE_BUDGET_MB", 4096))
+from ..analysis import knobs
+
+_CHUNK_TARGET = knobs.get_int("DS_TPU_CE_CHUNK")  # 0 = auto (memory-budgeted)
+_BUDGET_MB = knobs.get_int("DS_TPU_CE_BUDGET_MB")
 
 
 def _auto_target(S: int, B: int, V: int) -> int:

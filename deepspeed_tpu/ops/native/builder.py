@@ -16,11 +16,12 @@ import subprocess
 from pathlib import Path
 from typing import Dict, List, Optional
 
+from ...analysis import knobs
 from ...utils.logging import logger
 
 # repo layout: csrc/ sits next to the package (reference keeps csrc/ at top level)
 CSRC_DIR = Path(__file__).resolve().parents[3] / "csrc"
-CACHE_DIR = Path(os.environ.get("DS_TPU_BUILD_DIR", Path.home() / ".cache" / "deepspeed_tpu" / "build"))
+CACHE_DIR = Path(knobs.get_str("DS_TPU_BUILD_DIR") or Path.home() / ".cache" / "deepspeed_tpu" / "build")
 
 _loaded: Dict[str, Optional[ctypes.CDLL]] = {}
 

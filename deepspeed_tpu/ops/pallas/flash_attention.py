@@ -18,13 +18,13 @@ instead of materializing expanded cotangents.
 """
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ...analysis import knobs
 from ..registry import REGISTRY, pallas_available
 from ._utils import block_that_divides, compiler_params as _compiler_params
 
@@ -37,8 +37,8 @@ LANES = 128  # min lane width for fp32 stores (canonical TPU l/m layout)
 # FLOP-bound. (512, 512) keeps the fp32 score block at 1 MB of VMEM,
 # amortizes the chain over 16x more MXU work, and stays causal-efficient
 # at the block boundary. Overridable for autotuning.
-DEFAULT_BQ = int(os.environ.get("DS_TPU_FLASH_BQ", 512))
-DEFAULT_BK = int(os.environ.get("DS_TPU_FLASH_BK", 512))
+DEFAULT_BQ = knobs.get_int("DS_TPU_FLASH_BQ")
+DEFAULT_BK = knobs.get_int("DS_TPU_FLASH_BK")
 
 
 _WARNED: set = set()

@@ -10,10 +10,10 @@ Each op name maps to an ordered list of implementations; the first whose
 and by configs that disable Pallas).
 """
 
-import os
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..analysis import knobs
 from ..utils.logging import logger
 
 
@@ -54,7 +54,7 @@ class _Registry:
         impls = self._ops.get(op_name, [])
         if not impls:
             raise KeyError(f"No implementation registered for op '{op_name}'")
-        forced = self._forced.get(op_name) or os.environ.get(f"DS_TPU_OP_{op_name.upper()}")
+        forced = self._forced.get(op_name) or knobs.get_str(f"DS_TPU_OP_{op_name.upper()}")
         if forced:
             for impl in impls:
                 if impl.name == forced:

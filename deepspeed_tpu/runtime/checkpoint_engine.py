@@ -31,6 +31,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
+from ..analysis import knobs
 from ..utils.logging import logger
 
 
@@ -251,7 +252,7 @@ def create_checkpoint_engine(config=None) -> CheckpointEngine:
     overrides): auto -> orbax sharded writes when multi-process, msgpack
     otherwise; ``checkpoint.async_save`` adds the background commit."""
     ckpt_cfg = getattr(config, "checkpoint_config", None)
-    name = (os.environ.get("DS_TPU_CKPT_ENGINE") or getattr(ckpt_cfg, "engine", "auto")).lower()
+    name = (knobs.get_str("DS_TPU_CKPT_ENGINE") or getattr(ckpt_cfg, "engine", "auto")).lower()
     async_save = bool(getattr(ckpt_cfg, "async_save", False))
     if name not in ("auto", "orbax", "msgpack"):
         raise ValueError(f"unknown checkpoint engine {name!r}: expected auto | orbax | msgpack")

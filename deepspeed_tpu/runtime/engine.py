@@ -32,6 +32,7 @@ import optax
 
 from .. import comm as dist
 from ..accelerator import get_accelerator
+from ..analysis import knobs
 from ..parallel.mesh import MeshTopology, get_mesh_topology, initialize_mesh
 from ..telemetry import MonitorBridge
 from ..telemetry import get_registry as get_telemetry_registry
@@ -268,7 +269,7 @@ class DeepSpeedEngine:
         self._last_step_pc = None
         self._monitor_bridge = MonitorBridge(
             tele, self.monitor,
-            every_n_steps=int(os.environ.get("DS_TPU_TELEMETRY_FLUSH_STEPS", "1")))
+            every_n_steps=knobs.get_int("DS_TPU_TELEMETRY_FLUSH_STEPS"))
         # health sentinels observe at the SAME host-sync points as the
         # gauges above — anomaly detection never adds a device readback
         self.health = get_health_monitor()

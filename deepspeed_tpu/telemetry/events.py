@@ -30,13 +30,13 @@ disables emission entirely.
 """
 
 import json
-import os
 import queue
 import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
+from ..analysis import knobs
 from .registry import get_registry
 
 # kinds that belong to a request's lifecycle state machine, in legal order
@@ -178,10 +178,10 @@ def get_event_log() -> EventLog:
     ``DS_TPU_TELEMETRY=0`` disables."""
     global _EVENT_LOG
     if _EVENT_LOG is None:
-        path = os.environ.get("DS_TPU_EVENT_LOG", "")
+        path = knobs.get_str("DS_TPU_EVENT_LOG", "")
         _EVENT_LOG = EventLog(
-            capacity=int(os.environ.get("DS_TPU_EVENT_RING", "65536")),
-            enabled=os.environ.get("DS_TPU_TELEMETRY", "1") != "0",
+            capacity=knobs.get_int("DS_TPU_EVENT_RING"),
+            enabled=knobs.get_bool("DS_TPU_TELEMETRY"),
             sink_path=None if path in ("", "0") else path,
         )
     return _EVENT_LOG

@@ -27,13 +27,13 @@ event stream and polled from the generate/SLA loops and the watchdog).
 """
 
 import math
-import os
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional
 
+from ..analysis import knobs
 from .registry import get_registry
 
 _NEG_INF = float("-inf")
@@ -210,7 +210,7 @@ class QueueStallDetector(Detector):
     def __init__(self, stall_s: Optional[float] = None, **kw):
         super().__init__(**kw)
         if stall_s is None:
-            stall_s = float(os.environ.get("DS_TPU_STALL_S", "30"))
+            stall_s = knobs.get_float("DS_TPU_STALL_S")
         self.stall_s = float(stall_s)
         self.waiting: set = set()
         self.last_progress: Optional[float] = None
@@ -432,7 +432,7 @@ def get_health_monitor() -> HealthMonitor:
     if _MONITOR is None:
         _MONITOR = HealthMonitor()
         _MONITOR.add_sink(LoggerAlertSink())
-        path = os.environ.get("DS_TPU_HEALTH_LOG", "")
+        path = knobs.get_str("DS_TPU_HEALTH_LOG", "")
         if path not in ("", "0"):
             _MONITOR.add_sink(JsonlAlertSink(path))
         from .events import get_event_log

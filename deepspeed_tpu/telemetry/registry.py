@@ -21,11 +21,12 @@ matching ``[a-z_][a-z0-9_]*``) and ``snapshot()`` (JSON-able dict).
 """
 
 import bisect
-import os
 import re
 import threading
 import time
 from typing import Dict, Iterator, Optional, Tuple
+
+from ..analysis import knobs
 
 _NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
 
@@ -265,5 +266,5 @@ def get_registry() -> MetricsRegistry:
     """The process-wide registry. ``DS_TPU_TELEMETRY=0`` starts it disabled."""
     global _REGISTRY
     if _REGISTRY is None:
-        _REGISTRY = MetricsRegistry(enabled=os.environ.get("DS_TPU_TELEMETRY", "1") != "0")
+        _REGISTRY = MetricsRegistry(enabled=knobs.get_bool("DS_TPU_TELEMETRY"))
     return _REGISTRY

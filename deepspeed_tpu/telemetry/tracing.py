@@ -24,6 +24,8 @@ import time
 from collections import deque
 from typing import Optional
 
+from ..analysis import knobs
+
 _TLS = threading.local()
 
 
@@ -175,9 +177,9 @@ def get_tracer() -> SpanTracer:
     global _TRACER
     if _TRACER is None:
         _TRACER = SpanTracer(
-            capacity=int(os.environ.get("DS_TPU_TRACE_RING", "4096")),
-            enabled=os.environ.get("DS_TPU_TELEMETRY", "1") != "0",
-            annotate_xla=os.environ.get("DS_TPU_TRACE_XLA", "0") == "1",
+            capacity=knobs.get_int("DS_TPU_TRACE_RING"),
+            enabled=knobs.get_bool("DS_TPU_TELEMETRY"),
+            annotate_xla=knobs.get_bool("DS_TPU_TRACE_XLA"),
         )
     return _TRACER
 

@@ -7,9 +7,10 @@ process per host), not one process per chip.
 """
 
 import logging
-import os
 import sys
 from typing import List, Optional
+
+from ..analysis import knobs
 
 LOG_LEVELS = {
     "debug": logging.DEBUG,
@@ -34,7 +35,7 @@ def _create_logger(name: str = "deepspeed_tpu", level=logging.INFO) -> logging.L
     return lg
 
 
-logger = _create_logger(level=LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+logger = _create_logger(level=LOG_LEVELS.get((knobs.get_str("DS_TPU_LOG_LEVEL") or "info").lower(), logging.INFO))
 
 
 def _process_index() -> int:

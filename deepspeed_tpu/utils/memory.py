@@ -1,15 +1,15 @@
 """Memory reporting. Reference: ``see_memory_usage`` in ``runtime/utils.py``."""
 
 import gc
-import os
 
+from ..analysis import knobs
 from .logging import logger
 
 
 def see_memory_usage(message: str, force: bool = False, ranks=(0,)):
     import jax
 
-    if not force and not os.environ.get("DS_TPU_MEMORY_DEBUG"):
+    if not force and not knobs.get_bool("DS_TPU_MEMORY_DEBUG"):
         return
     if jax.process_index() not in ranks:
         return

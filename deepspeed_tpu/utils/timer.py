@@ -27,7 +27,7 @@ def _sync():
     import jax
     import jax.numpy as jnp
 
-    (jnp.zeros(()) + 0).block_until_ready()
+    (jnp.zeros(()) + 0).block_until_ready()  # graft-lint: readback (wall-clock timers sync by design)
 
 
 class SynchronizedWallClockTimer:

@@ -11,10 +11,11 @@ with the engine's ``last_step_completed_unix`` heartbeat gauge this
 makes a wedged tunnel distinguishable from a merely slow step.
 """
 
-import os
 import threading
 import time
 from typing import Any, Callable, Optional, Tuple
+
+from ..analysis import knobs
 
 DEFAULT_TIMEOUT_S = 180.0
 
@@ -23,7 +24,7 @@ def default_timeout() -> float:
     """The watchdog deadline when callers pass none: 180 s, overridable
     via ``DS_TPU_WATCHDOG_TIMEOUT_S``."""
     try:
-        return float(os.environ.get("DS_TPU_WATCHDOG_TIMEOUT_S", DEFAULT_TIMEOUT_S))
+        return knobs.get_float("DS_TPU_WATCHDOG_TIMEOUT_S", DEFAULT_TIMEOUT_S)
     except ValueError:
         return DEFAULT_TIMEOUT_S
 

@@ -1,0 +1,405 @@
+"""graft-lint: fixture tests per static check, repo-clean gate, knob drift.
+
+The checker (``deepspeed_tpu/analysis/static_checks.py``) is stdlib-only
+and is loaded from its file path exactly the way ``tools/graft_lint.py``
+loads it — these tests never import jax.
+"""
+
+import importlib.util
+import pathlib
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+CHECKS_PATH = ROOT / "deepspeed_tpu" / "analysis" / "static_checks.py"
+KNOBS_PATH = ROOT / "deepspeed_tpu" / "analysis" / "knobs.py"
+
+
+def _load_checks():
+    spec = importlib.util.spec_from_file_location("graft_lint_checks_test", str(CHECKS_PATH))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+checks = _load_checks()
+
+
+def lint(src, **kw):
+    return checks.lint_source(textwrap.dedent(src), **kw)
+
+
+def by_check(findings, name):
+    return [f for f in findings if f.check == name]
+
+
+# ------------------------------------------------------------------ host-sync
+class TestHostSync:
+
+    def test_np_asarray_on_device_value_flagged(self):
+        out = lint("""
+            def _run_decode(self, x):
+                logits = self._decode_fn(x)
+                return np.asarray(logits)
+        """)
+        hits = by_check(out, "host-sync")
+        assert len(hits) == 1 and hits[0].line == 4
+
+    def test_item_and_float_on_device_value_flagged(self):
+        out = lint("""
+            def _run_fused(self):
+                t = jnp.zeros((4,))
+                a = float(t)
+                b = t.item()
+                return a, b
+        """)
+        assert len(by_check(out, "host-sync")) == 2
+
+    def test_device_get_flagged_unless_sanctioned(self):
+        out = lint("""
+            def _run_spec_step(self, logits):
+                return jax.device_get(logits)
+        """)
+        assert len(by_check(out, "host-sync")) == 1
+        out = lint("""
+            def _run_spec_step(self, logits):
+                return jax.device_get(logits)  # graft-lint: readback (the one fetch)
+        """)
+        assert not by_check(out, "host-sync")
+
+    def test_block_until_ready_flagged(self):
+        out = lint("""
+            def _run_decode_burst(self, x):
+                y = self._decode_fn(x)
+                y.block_until_ready()
+                return y
+        """)
+        assert len(by_check(out, "host-sync")) == 1
+
+    def test_cold_path_not_flagged(self):
+        # same sinks, but the function is not reachable from a hot root
+        out = lint("""
+            def save_checkpoint(self, x):
+                y = jnp.zeros((4,))
+                return np.asarray(y), jax.device_get(x)
+        """)
+        assert not by_check(out, "host-sync")
+
+    def test_host_values_not_flagged(self):
+        out = lint("""
+            def _run_prefill_batch(self, rows):
+                ids = np.zeros((4, 8))
+                n = int(ids.shape[0])
+                return np.stack([ids, ids]), n
+        """)
+        assert not by_check(out, "host-sync")
+
+    def test_meta_attrs_break_taint(self):
+        out = lint("""
+            def _run_decode(self):
+                t = jnp.zeros((4,))
+                return int(t.shape[0])
+        """)
+        assert not by_check(out, "host-sync")
+
+    def test_reachability_through_helper(self):
+        # helper is flagged because the hot root calls it
+        out = lint("""
+            def _generate_fused(self):
+                return self._helper()
+
+            def _helper(self):
+                t = jnp.zeros(())
+                return float(t)
+        """)
+        assert len(by_check(out, "host-sync")) == 1
+
+
+# -------------------------------------------------------------- jit-recompile
+class TestJitRecompile:
+
+    def test_raw_int_at_slice_flagged(self):
+        out = lint("""
+            def _run_fused(self, rows, ids_dev, col):
+                n = len(rows)
+                ids_dev = ids_dev.at[:n].set(col)
+                return ids_dev
+        """)
+        hits = by_check(out, "jit-recompile")
+        assert len(hits) == 1 and "'n'" in hits[0].message
+
+    def test_bucketed_bound_not_flagged(self):
+        out = lint("""
+            def _run_fused(self, rows, ids_dev, col):
+                n = len(rows)
+                B = _next_pow2(n)
+                ids_dev = ids_dev.at[:B].set(col)
+                return ids_dev
+        """)
+        assert not by_check(out, "jit-recompile")
+
+    def test_stack_over_comprehension_flagged(self):
+        out = lint("""
+            def _run_spec_step(self, carried):
+                return jnp.stack([jnp.asarray(t) for t in carried])
+        """)
+        assert len(by_check(out, "jit-recompile")) == 1
+
+    def test_sanction_comment_accepted(self):
+        out = lint("""
+            def _run_spec_step(self, carried):
+                return jnp.stack([jnp.asarray(t) for t in carried])  # graft-lint: bucketed
+        """)
+        assert not by_check(out, "jit-recompile")
+
+    def test_cold_path_not_flagged(self):
+        out = lint("""
+            def build_report(self, rows, ids_dev, col):
+                n = len(rows)
+                return ids_dev.at[:n].set(col)
+        """)
+        assert not by_check(out, "jit-recompile")
+
+
+# -------------------------------------------------------------- donated-reuse
+class TestDonatedReuse:
+
+    def test_use_after_donation_flagged(self):
+        out = lint("""
+            def _run_decode(self, params, ids, pos, k_pages, v_pages):
+                logits, k2, v2 = self._decode_fn(params, ids, pos, k_pages, v_pages)
+                return logits, k_pages.shape
+        """)
+        hits = by_check(out, "donated-reuse")
+        assert len(hits) == 1 and "k_pages" in hits[0].message
+
+    def test_rebinding_in_same_statement_ok(self):
+        out = lint("""
+            def _run_decode(self, params, ids, pos):
+                logits, self.k_pages, self.v_pages = self._decode_fn(
+                    params, ids, pos, self.k_pages, self.v_pages)
+                return logits, self.k_pages
+        """)
+        assert not by_check(out, "donated-reuse")
+
+    def test_local_jit_donation_tracked(self):
+        out = lint("""
+            def step(self, buf, x):
+                fn = jax.jit(lambda b, v: b + v, donate_argnums=(0,))
+                out = fn(buf, x)
+                return out + buf
+        """)
+        hits = by_check(out, "donated-reuse")
+        assert len(hits) == 1 and "buf" in hits[0].message
+
+    def test_sanction_comment_accepted(self):
+        out = lint("""
+            def _run_decode(self, params, ids, pos, k_pages, v_pages):
+                logits, k2, v2 = self._decode_fn(params, ids, pos, k_pages, v_pages)  # graft-lint: donated-ok
+                return logits, k_pages.shape
+        """)
+        assert not by_check(out, "donated-reuse")
+
+    def test_factory_call_donation(self):
+        out = lint("""
+            def _run_fused(self, params, ids, pos, k_pages, v_pages):
+                fn = self._fused_for(4, 2)
+                toks, k2, v2 = fn(params, ids, pos, k_pages, v_pages)
+                return toks, v_pages
+        """)
+        hits = by_check(out, "donated-reuse")
+        assert len(hits) == 1 and "v_pages" in hits[0].message
+
+
+# ----------------------------------------------------------------------- knob
+class TestKnobCheck:
+
+    def test_environ_read_outside_registry_flagged(self):
+        out = lint("""
+            import os
+            def f():
+                return os.environ.get("DS_TPU_FOO", "1")
+        """, declared_knobs={"DS_TPU_FOO"})
+        hits = by_check(out, "knob")
+        assert len(hits) == 1 and "outside analysis/knobs.py" in hits[0].message
+
+    def test_undeclared_knob_flagged_even_via_registry(self):
+        out = lint("""
+            from deepspeed_tpu.analysis import knobs
+            def f():
+                return knobs.get_bool("DS_TPU_NOT_DECLARED")
+        """)
+        hits = by_check(out, "knob")
+        assert len(hits) == 1 and "not declared" in hits[0].message
+
+    def test_declared_knob_via_registry_clean(self):
+        out = lint("""
+            from deepspeed_tpu.analysis import knobs
+            def f():
+                return knobs.get_bool("DS_TPU_FOO")
+        """, declared_knobs={"DS_TPU_FOO"})
+        assert not by_check(out, "knob")
+
+    def test_fstring_prefix_family(self):
+        out = lint("""
+            from deepspeed_tpu.analysis import knobs
+            def f(name):
+                return knobs.get_str(f"DS_TPU_OP_{name.upper()}")
+        """, knob_prefixes={"DS_TPU_OP_"})
+        assert not by_check(out, "knob")
+
+    def test_subscript_read_flagged(self):
+        out = lint("""
+            import os
+            def f():
+                return os.environ["DS_TPU_BAR"]
+        """)
+        assert len(by_check(out, "knob")) == 2  # stray read + undeclared
+
+    def test_non_ds_tpu_env_ignored(self):
+        out = lint("""
+            import os
+            def f():
+                return os.environ.get("JAX_PLATFORMS")
+        """)
+        assert not by_check(out, "knob")
+
+
+# ----------------------------------------------------- registry/docs drift
+def _declared():
+    return checks.load_declared_knobs(str(KNOBS_PATH))
+
+
+class TestKnobDrift:
+
+    def test_registry_parse(self):
+        names, prefixes = _declared()
+        assert "DS_TPU_SERVE_FUSED" in names
+        assert "DS_TPU_OP_" in prefixes
+
+    def test_every_code_read_is_declared_and_routed(self):
+        """The real enforcement: linting the package yields zero knob
+        findings (covers both 'stray os.environ read' and 'undeclared')."""
+        findings = checks.lint_paths([str(ROOT / "deepspeed_tpu")])
+        assert not by_check(findings, "knob"), [f.render() for f in by_check(findings, "knob")]
+
+    def test_docs_cover_registry_both_directions(self):
+        names, prefixes = _declared()
+        docs = ((ROOT / "docs" / "ANALYSIS.md").read_text()
+                + (ROOT / "docs" / "OBSERVABILITY.md").read_text())
+        doc_names = set(re.findall(r"DS_TPU_[A-Z0-9_]*[A-Z0-9]", docs))
+
+        # docs spell prefix families as DS_TPU_OP_<NAME>, so the regex
+        # captures the family name without its trailing underscore
+        def in_family(d):
+            return any(d.startswith(p) or p == d + "_" for p in prefixes)
+
+        # registry -> docs: every declared knob is documented
+        undocumented = {n for n in names if n not in doc_names}
+        assert not undocumented, f"knobs declared but undocumented: {sorted(undocumented)}"
+        for p in prefixes:
+            assert any(p == d + "_" or d.startswith(p) for d in doc_names), \
+                f"prefix family {p}* undocumented"
+
+        # docs -> registry: every documented DS_TPU_* name is declared
+        phantom = {d for d in doc_names if d not in names and not in_family(d)}
+        assert not phantom, f"knobs documented but not declared: {sorted(phantom)}"
+
+    def test_registry_defaults_match_docs_tables(self):
+        """Defaults shown in the docs' knob tables must match declare()."""
+        names, _ = _declared()
+        import ast as _ast
+        tree = _ast.parse(KNOBS_PATH.read_text())
+        defaults = {}
+        for node in _ast.walk(tree):
+            if isinstance(node, _ast.Call) and isinstance(node.func, _ast.Name) \
+                    and node.func.id == "declare" and len(node.args) >= 2:
+                name = node.args[0].value if isinstance(node.args[0], _ast.Constant) else None
+                dflt = node.args[1].value if isinstance(node.args[1], _ast.Constant) else None
+                if isinstance(name, str):
+                    defaults[name] = dflt
+        docs = ((ROOT / "docs" / "ANALYSIS.md").read_text()
+                + (ROOT / "docs" / "OBSERVABILITY.md").read_text())
+        row_re = re.compile(r"\|\s*`(DS_TPU_[A-Z0-9_]+)`[^|]*\|\s*([^|]+)\|")
+        for name, cell in row_re.findall(docs):
+            if name not in defaults:
+                continue
+            cell = cell.strip()
+            declared = defaults[name]
+            if declared is None:
+                assert cell == "unset", f"{name}: docs say {cell!r}, registry default is None"
+            else:
+                assert cell == f"`{declared}`", \
+                    f"{name}: docs say {cell!r}, registry default is {declared!r}"
+
+
+# ----------------------------------------------------------- repo-clean gate
+def test_repo_clean():
+    """The package itself must lint clean (after the committed baseline) —
+    the same invocation CI and ``tools/graft_lint.py`` run."""
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "graft_lint.py"), str(ROOT / "deepspeed_tpu")],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, f"graft-lint found new violations:\n{proc.stdout}{proc.stderr}"
+
+
+def test_planted_violations_all_flagged_with_location():
+    """One source planting all four check classes: each is reported with
+    the right file:line."""
+    src = textwrap.dedent("""
+        import os
+
+        def _run_fused(self, rows, ids_dev, col, k_pages, v_pages):
+            n = len(rows)                                   # line 4
+            t = jnp.zeros((4,))                             # line 5
+            bad_sync = float(t)                             # line 6  host-sync
+            ids_dev = ids_dev.at[:n].set(col)               # line 7  jit-recompile
+            toks, k2, v2 = self._prefill_fn(0, 1, 2, k_pages, v_pages)
+            leak = k_pages + 1                              # line 9  donated-reuse
+            flag = os.environ.get("DS_TPU_PLANTED")         # line 10 knob x2
+            return bad_sync, ids_dev, leak, flag
+    """)
+    out = checks.lint_source(src, path="planted.py")
+    got = {(f.check, f.line) for f in out}
+    assert ("host-sync", 7) in got
+    assert ("jit-recompile", 8) in got
+    assert ("donated-reuse", 10) in got
+    assert any(c == "knob" and ln == 11 for c, ln in got)
+    assert all(f.path == "planted.py" for f in out)
+
+
+def test_baseline_suppression_roundtrip(tmp_path):
+    """A baselined finding is suppressed; a new finding still fails."""
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent("""
+        def _run_decode(self, x):
+            t = jnp.zeros(())
+            return float(t)
+    """))
+    tool = str(ROOT / "tools" / "graft_lint.py")
+    baseline = tmp_path / "baseline.txt"
+
+    proc = subprocess.run([sys.executable, tool, str(bad), "--baseline", str(baseline)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1 and "[host-sync]" in proc.stdout
+
+    subprocess.run([sys.executable, tool, str(bad), "--baseline", str(baseline),
+                    "--write-baseline"], capture_output=True, text=True, check=True)
+    proc = subprocess.run([sys.executable, tool, str(bad), "--baseline", str(baseline)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout
+
+    # a NEW violation in the same file is not covered by the old baseline
+    bad.write_text(bad.read_text() + textwrap.dedent("""
+        def _run_prefill_batch(self, y):
+            u = jnp.ones(())
+            return u.item()
+    """))
+    proc = subprocess.run([sys.executable, tool, str(bad), "--baseline", str(baseline)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 1 and ".item()" in proc.stdout

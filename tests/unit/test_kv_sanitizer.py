@@ -1,0 +1,278 @@
+"""Runtime sanitizers: shadow KV refcounts, JitAuditor, transfer guard.
+
+Unit tests inject each invariant break directly and assert the precise
+trap message; the engine-level tests run the fused and speculative
+serving paths end-to-end with ``DS_TPU_KV_SANITIZE=1`` +
+``DS_TPU_JIT_AUDIT=1`` + ``DS_TPU_TRANSFER_GUARD=1`` and assert parity
+with the unsanitized run.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.analysis.jit_audit import JitAuditor, _leaf_signature
+from deepspeed_tpu.analysis.kv_sanitizer import KVSanitizerError, ShadowRefcounts
+from deepspeed_tpu.analysis.transfer_guard import maybe_guard, no_implicit_host_transfers
+from deepspeed_tpu.inference.v2 import (BlockedAllocator, DSStateManager, InferenceEngineV2,
+                                        RaggedBatchConfig, RaggedInferenceEngineConfig)
+
+
+# ------------------------------------------------------------- shadow refcounts
+class TestShadowRefcounts:
+
+    def _wired(self, n=8):
+        alloc = BlockedAllocator(n)
+        san = ShadowRefcounts()
+        alloc.set_sanitizer(san)
+        return alloc, san
+
+    def test_mirrors_allocate_retain_release(self):
+        alloc, san = self._wired()
+        blocks = alloc.allocate(3)
+        assert san.live_blocks() == set(blocks)
+        alloc.retain(blocks[0])
+        assert san.refcount(blocks[0]) == 2
+        alloc.release(blocks)
+        assert san.refcount(blocks[0]) == 1 and san.refcount(blocks[1]) == 0
+        alloc.release([blocks[0]])
+        assert not san.live_blocks()
+
+    def test_double_free_trapped_with_block_id(self):
+        alloc, san = self._wired()
+        (b,) = alloc.allocate(1)
+        alloc.release([b])
+        with pytest.raises(KVSanitizerError, match=rf"double free of block {b} .*refcount is already 0"):
+            san.on_release(b)
+
+    def test_retain_of_dead_block_trapped(self):
+        _, san = self._wired()
+        with pytest.raises(KVSanitizerError, match=r"retain of block 5 which has no live holders"):
+            san.on_retain(5)
+
+    def test_shared_write_without_cow_trapped(self):
+        alloc, san = self._wired()
+        blocks = alloc.allocate(2)
+        alloc.retain(blocks[1])  # second holder: block is shared
+        with pytest.raises(KVSanitizerError,
+                           match=rf"writing positions \[10, 14\) into block {blocks[1]} "
+                                 rf"\(refcount 2\) without copy-on-write"):
+            san.check_write(7, blocks, start_pos=10, n_tokens=4, block_size=8,
+                            refcount_of=alloc.refcount)
+
+    def test_unshared_write_clean(self):
+        alloc, san = self._wired()
+        blocks = alloc.allocate(2)
+        san.check_write(7, blocks, start_pos=0, n_tokens=16, block_size=8,
+                        refcount_of=alloc.refcount)
+
+    def test_write_outside_shared_block_clean(self):
+        # positions [0, 8) only touch block 0; sharing block 1 is fine
+        alloc, san = self._wired()
+        blocks = alloc.allocate(2)
+        alloc.retain(blocks[1])
+        san.check_write(7, blocks, start_pos=0, n_tokens=8, block_size=8,
+                        refcount_of=alloc.refcount)
+
+    def test_leak_at_flush_trapped(self):
+        alloc, san = self._wired()
+        blocks = alloc.allocate(3)
+        with pytest.raises(KVSanitizerError,
+                           match=rf"1 block\(s\) leaked at flush: \[{blocks[2]}\]"):
+            san.check_leaks(allocated=blocks, reachable=set(blocks[:2]))
+
+    def test_refcount_drift_trapped(self):
+        alloc, san = self._wired(4)
+        alloc.allocate(2)
+        alloc._refcount[0] += 1  # mutation that bypassed the public API
+        with pytest.raises(KVSanitizerError, match=r"refcount drift on block 0"):
+            san.verify_against(alloc._refcount)
+
+
+class TestManagerIntegration:
+
+    @pytest.fixture
+    def manager(self, monkeypatch):
+        monkeypatch.setenv("DS_TPU_KV_SANITIZE", "1")
+        return DSStateManager(RaggedBatchConfig(kv_block_size=4, max_context=64),
+                              num_kv_blocks=16)
+
+    def test_sanitizer_installed_and_flush_verifies(self, manager):
+        assert manager.sanitizer is not None
+        seq = manager.get_or_create_sequence(1)
+        manager.allocate_for(seq, 10)
+        manager.sanitize_verify()  # live seq blocks are reachable
+        manager.flush_all()  # runs sanitize_verify at the end
+        assert not manager.sanitizer.live_blocks()
+
+    def test_injected_leak_trapped_at_flush(self, manager):
+        seq = manager.get_or_create_sequence(1)
+        manager.allocate_for(seq, 10)
+        leaked = seq.blocks.pop()  # drop bookkeeping without releasing
+        with pytest.raises(KVSanitizerError, match=rf"leaked at flush: \[{leaked}\]"):
+            manager.sanitize_verify()
+
+    def test_shared_write_without_cow_trapped(self, manager):
+        seq = manager.get_or_create_sequence(1)
+        manager.allocate_for(seq, 8)
+        manager._allocator.retain(seq.blocks[1])  # simulate a cache holder
+        try:
+            with pytest.raises(KVSanitizerError, match="without copy-on-write"):
+                manager.sanitize_write(seq, start_pos=4, n_tokens=4)
+        finally:
+            manager._allocator.release([seq.blocks[1]])
+
+    def test_registered_root_not_a_leak(self, manager):
+        (garbage,) = manager._allocator.allocate(1)
+        manager.register_sanitizer_root(garbage)
+        manager.sanitize_verify()
+
+    def test_sanitize_write_noop_when_disabled(self):
+        sm = DSStateManager(RaggedBatchConfig(kv_block_size=4, max_context=64),
+                            num_kv_blocks=16)
+        assert sm.sanitizer is None
+        seq = sm.get_or_create_sequence(1)
+        sm.allocate_for(seq, 4)
+        sm.sanitize_write(seq, 0, 4)
+        sm.sanitize_verify()
+
+
+# ------------------------------------------------------------------ jit auditor
+class _FakeMonitor:
+
+    def __init__(self):
+        self.raised = []
+        self.resolved = []
+
+    def raise_alert(self, name, message, **attrs):
+        self.raised.append((name, message, attrs))
+
+    def resolve(self, name):
+        self.resolved.append(name)
+
+
+class TestJitAuditor:
+
+    def test_signature_shapes_and_scalar_types(self):
+        a = np.zeros((4, 2), np.int32)
+        assert _leaf_signature(a) == ("arr", (4, 2), "int32")
+        assert _leaf_signature(3) == _leaf_signature(7)  # values don't retrace
+        assert _leaf_signature(3) != _leaf_signature(3.0)  # types do
+
+    def test_counts_one_compile_per_new_signature(self):
+        aud = JitAuditor(use_telemetry=False)
+        fn = aud.wrap("step", lambda x: x)
+        fn(np.zeros((4,)))
+        fn(np.zeros((4,)))  # warm
+        fn(np.zeros((8,)))  # new shape
+        assert aud.compiles == 2
+        assert aud.steady_recompiles == 0  # warmup: not steady yet
+
+    def test_steady_recompile_raises_exactly_one_alert(self):
+        mon = _FakeMonitor()
+        aud = JitAuditor(monitor=mon, use_telemetry=False)
+        fn = aud.wrap("decode", lambda x: x)
+        fn(np.zeros((4,)))
+        aud.mark_steady()
+        fn(np.zeros((4,)))  # warm signature: fine
+        assert not mon.raised
+        fn(np.zeros((8,)))   # recompile storm begins
+        fn(np.zeros((16,)))  # still the same episode
+        assert aud.steady_recompiles == 2
+        assert len(mon.raised) == 1
+        name, message, attrs = mon.raised[0]
+        assert name == "jit_recompile_storm" and attrs["program"] == "decode"
+        # a new steady episode re-arms the alert
+        aud.mark_steady()
+        assert "jit_recompile_storm" in mon.resolved
+        fn(np.zeros((32,)))
+        assert len(mon.raised) == 2
+
+    def test_rewrap_counts_fresh_compiles(self):
+        # LRU eviction rebuilds the program: its executables are gone, so the
+        # same signature through a new wrapper is a real compile
+        aud = JitAuditor(use_telemetry=False)
+        fn1 = aud.wrap("burst", lambda x: x)
+        fn1(np.zeros((4,)))
+        fn2 = aud.wrap("burst", lambda x: x)
+        fn2(np.zeros((4,)))
+        assert aud.compiles == 2
+
+    def test_wrapped_preserves_result(self):
+        aud = JitAuditor(use_telemetry=False)
+        fn = aud.wrap("f", lambda x, y: x + y)
+        assert fn(2, 3) == 5
+
+
+# -------------------------------------------------------------- transfer guard
+class TestTransferGuard:
+
+    def test_blocks_implicit_readback_allows_device_get(self):
+        x = jax.numpy.arange(8)
+        with no_implicit_host_transfers():
+            assert jax.device_get(x).sum() == 28  # explicit: always allowed
+            if jax.default_backend() != "cpu":
+                # CPU device->host is zero-copy, so the guard only has
+                # something to trap on a real accelerator
+                with pytest.raises(Exception):
+                    np.asarray(x)  # implicit: trapped
+
+    def test_maybe_guard_disabled_is_noop(self):
+        x = jax.numpy.arange(4)
+        with maybe_guard(False):
+            assert np.asarray(x).sum() == 6
+
+
+# --------------------------------------------------- engine under sanitizers
+def _tiny_engine(**cfg_kw):
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2, d_model=32,
+                            max_seq_len=128, norm="rmsnorm", activation="swiglu",
+                            pos_emb="rope", tie_embeddings=False)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    ecfg = RaggedInferenceEngineConfig(
+        state_manager=RaggedBatchConfig(kv_block_size=8, max_context=128, num_kv_blocks=64),
+        dtype="float32", **cfg_kw)
+    return InferenceEngineV2(model, params, ecfg)
+
+
+_PROMPTS = [[3, 17, 42, 9, 88, 5, 23], list(range(1, 12)), [5, 6, 7]]
+
+
+class TestEngineUnderSanitizers:
+
+    def test_fused_parity_and_clean_flush(self, monkeypatch):
+        baseline = _tiny_engine().generate(_PROMPTS, max_new_tokens=8)
+
+        monkeypatch.setenv("DS_TPU_KV_SANITIZE", "1")
+        monkeypatch.setenv("DS_TPU_JIT_AUDIT", "1")
+        monkeypatch.setenv("DS_TPU_TRANSFER_GUARD", "1")
+        eng = _tiny_engine()
+        assert eng.state.sanitizer is not None and eng.jit_auditor is not None
+        out = eng.generate(_PROMPTS, max_new_tokens=8)
+        assert out == baseline
+        assert eng.jit_auditor.compiles > 0
+        eng.state.sanitize_verify()
+        eng.state.flush_all()
+
+    def test_spec_parity_under_sanitizers(self, monkeypatch):
+        baseline = _tiny_engine().generate(_PROMPTS, max_new_tokens=8)
+
+        monkeypatch.setenv("DS_TPU_KV_SANITIZE", "1")
+        monkeypatch.setenv("DS_TPU_TRANSFER_GUARD", "1")
+        monkeypatch.setenv("DS_TPU_SPEC_DECODE", "1")
+        eng = _tiny_engine()
+        out = eng.generate(_PROMPTS, max_new_tokens=8)
+        assert out == baseline
+        eng.state.sanitize_verify()
+        eng.state.flush_all()
+
+    def test_steady_state_serving_no_recompiles(self, monkeypatch):
+        monkeypatch.setenv("DS_TPU_JIT_AUDIT", "1")
+        eng = _tiny_engine()
+        eng.generate(_PROMPTS, max_new_tokens=8)  # warmup compiles everything
+        eng.jit_auditor.mark_steady()
+        eng.generate(_PROMPTS, max_new_tokens=8)  # identical traffic
+        assert eng.jit_auditor.steady_recompiles == 0

@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""graft-lint CLI: run the JAX-hazard static checks over a source tree.
+
+Usage:
+    python tools/graft_lint.py deepspeed_tpu/
+    python tools/graft_lint.py --write-baseline deepspeed_tpu/
+
+Exit code 0 when every finding is clean or baselined, 1 otherwise.
+
+The checker (``deepspeed_tpu/analysis/static_checks.py``) is stdlib-only
+and is loaded straight from its file path so this tool never imports the
+package (and therefore never pays the jax import, and works in an
+environment without jax at all).
+"""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHECKS_PATH = os.path.join(REPO_ROOT, "deepspeed_tpu", "analysis", "static_checks.py")
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "graft_lint_baseline.txt")
+
+
+def _load_checks():
+    spec = importlib.util.spec_from_file_location("graft_lint_checks", CHECKS_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod  # dataclass machinery resolves the module by name
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="project-specific JAX-hazard linter")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files or directories to lint (default: deepspeed_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="suppression file (default: tools/graft_lint_baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every finding, ignoring the baseline")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline file from the current findings")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu")]
+    checks = _load_checks()
+    findings = checks.lint_paths(paths)
+
+    sources = {}
+    for f in {x.path for x in findings}:
+        try:
+            with open(f, "r", encoding="utf-8") as fh:
+                sources[f] = fh.read().splitlines()
+        except OSError:
+            sources[f] = []
+
+    def rel(p):
+        return os.path.relpath(p, REPO_ROOT).replace(os.sep, "/")
+
+    keyed = []
+    for fi in findings:
+        key = checks.baseline_key(fi, sources)
+        keyed.append((fi, (rel(fi.path), key[1], key[2])))
+
+    if args.write_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write("# graft-lint baseline: findings accepted as-is, one per line as\n"
+                    "#   relpath|check|stripped source line\n"
+                    "# Regenerate with: python tools/graft_lint.py --write-baseline\n")
+            for key in sorted({k for _, k in keyed}):
+                f.write("|".join(key) + "\n")
+        print(f"wrote {len({k for _, k in keyed})} baseline entries to {args.baseline}")
+        return 0
+
+    baseline = set() if args.no_baseline else checks.load_baseline(args.baseline)
+    fresh = [fi for fi, key in keyed if key not in baseline]
+    suppressed = len(findings) - len(fresh)
+
+    for fi in fresh:
+        print(f"{rel(fi.path)}:{fi.line}: [{fi.check}] {fi.message}")
+    tail = f" ({suppressed} baselined)" if suppressed else ""
+    print(f"graft-lint: {len(fresh)} finding(s){tail} over {len(paths)} path(s)")
+    return 1 if fresh else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
