@@ -1,0 +1,156 @@
+"""Cross-rank collective choreography auditor (``DS_TPU_COMM_AUDIT``).
+
+A divergent collective — one rank issuing an op its peers don't, or the
+same op with a different shape/dtype — surfaces on TPU as a silent hang,
+not a stack trace. When the knob is on, ``comm/comm.py`` records every
+eager collective into a per-process ledger (and ``comm/collectives.py``
+records in-jit collectives at trace time), and barrier points gather all
+ledgers with ``all_gather_object`` — which pads ragged payloads, so the
+cross-check itself cannot hang — and raise ``CommChoreographyError``
+naming the first divergent op with both ranks' recent context *before*
+entering the device barrier that would otherwise wedge.
+
+Off by default: ``get_auditor()`` caches the knob read and returns
+``None``, so the steady-state cost is one attribute check per eager op
+and nothing at all on the compiled serving path (in-jit recording is
+trace-time only).
+
+Stdlib-only (plus the knob registry): no jax import, usable from any
+layer without cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+from . import knobs
+
+KNOB = "DS_TPU_COMM_AUDIT"
+MAX_ENTRIES = 4096
+
+
+@dataclass(frozen=True)
+class CommOp:
+    """One recorded collective: what a rank is about to do."""
+
+    op: str
+    dtype: str = ""
+    shape: Tuple[int, ...] = ()
+    axis: str = ""
+
+    def render(self) -> str:
+        dims = "x".join(str(d) for d in self.shape) if self.shape else "scalar"
+        ax = f", axis={self.axis}" if self.axis else ""
+        return f"{self.op}({self.dtype or '?'}[{dims}]{ax})"
+
+
+@dataclass(frozen=True)
+class DivergenceReport:
+    """First point where two ranks' ledgers disagree."""
+
+    index: int                       # op index of the first mismatch
+    rank_a: int
+    rank_b: int
+    op_a: Optional[CommOp]           # None = this rank's ledger ended here
+    op_b: Optional[CommOp]
+    context_a: Tuple[CommOp, ...]    # ops immediately before the mismatch
+    context_b: Tuple[CommOp, ...]
+
+    def render(self) -> str:
+        def side(rank: int, op: Optional[CommOp], ctx: Tuple[CommOp, ...]) -> List[str]:
+            what = op.render() if op is not None else "<end of ledger>"
+            trail = " | ".join(c.render() for c in ctx) if ctx else "<start>"
+            return [f"  rank {rank}: {what}", f"  rank {rank} context: {trail}"]
+
+        lines = [f"collective choreography divergence at op index {self.index}:"]
+        lines += side(self.rank_a, self.op_a, self.context_a)
+        lines += side(self.rank_b, self.op_b, self.context_b)
+        return "\n".join(lines)
+
+
+class CommChoreographyError(RuntimeError):
+    """Raised at a barrier point instead of entering a doomed collective."""
+
+    def __init__(self, report: DivergenceReport, barrier: str = ""):
+        self.report = report
+        where = f" (barrier '{barrier}')" if barrier else ""
+        super().__init__(report.render() + where)
+
+
+class CommAuditor:
+    """Per-process ordered ledger of issued collectives. Thread-safe;
+    bounded so a long run cannot grow without limit (the cross-check
+    compares only what both sides retain)."""
+
+    def __init__(self, max_entries: int = MAX_ENTRIES):
+        self._lock = threading.Lock()
+        self._ops: List[CommOp] = []
+        self._dropped = 0
+        self._max = max_entries
+
+    def record(self, op: str, dtype: str = "", shape: Sequence[int] = (),
+               axis: str = "") -> None:
+        entry = CommOp(op=op, dtype=str(dtype),
+                       shape=tuple(int(d) for d in shape), axis=str(axis or ""))
+        with self._lock:
+            if len(self._ops) >= self._max:
+                self._dropped += 1
+                return
+            self._ops.append(entry)
+
+    def entries(self) -> List[CommOp]:
+        with self._lock:
+            return list(self._ops)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ops.clear()
+            self._dropped = 0
+
+
+def cross_check(ledgers: Sequence[Sequence[CommOp]], *,
+                context: int = 3) -> Optional[DivergenceReport]:
+    """Compare every rank's ledger against rank 0's; return the first
+    divergence found, or None when all ledgers agree."""
+    if not ledgers:
+        return None
+    base = list(ledgers[0])
+    for rank, raw in enumerate(ledgers[1:], start=1):
+        led = list(raw)
+        for i in range(max(len(base), len(led))):
+            a = base[i] if i < len(base) else None
+            b = led[i] if i < len(led) else None
+            if a != b:
+                return DivergenceReport(
+                    index=i, rank_a=0, rank_b=rank, op_a=a, op_b=b,
+                    context_a=tuple(base[max(0, i - context):i]),
+                    context_b=tuple(led[max(0, i - context):i]))
+    return None
+
+
+_auditor: Optional[CommAuditor] = None
+_resolved = False
+
+
+def get_auditor() -> Optional[CommAuditor]:
+    """The process-wide auditor when DS_TPU_COMM_AUDIT is on, else None.
+    The knob is read once; flipping the env mid-process requires
+    ``_reset_for_tests()``."""
+    global _auditor, _resolved
+    if not _resolved:
+        _auditor = CommAuditor() if knobs.get_bool(KNOB) else None
+        _resolved = True
+    return _auditor
+
+
+def _reset_for_tests() -> None:
+    global _auditor, _resolved
+    _auditor = None
+    _resolved = False

@@ -1,0 +1,848 @@
+"""graft-lint/dist: mesh & collective consistency + concurrency checks.
+
+The second analyzer family (docs/ANALYSIS.md has the catalog and
+sanction syntax). Three checks aimed at the failure modes that surface
+as silent hangs on TPU rather than stack traces:
+
+- ``collective-axis``       a ``lax`` collective's literal axis name must
+                            be a declared mesh axis (vocabulary recovered
+                            from ``ALL_AXES`` / literal ``Mesh(...)`` /
+                            ``jax.make_mesh`` sites) AND the collective
+                            must sit in a function entered via
+                            ``shard_map``/``pmap``/``pjit`` somewhere in
+                            the call graph; ``PartitionSpec`` literals are
+                            vocabulary-checked too
+                            (sanction: ``# graft-lint: axis-ok``)
+- ``divergent-collective``  a collective (device or host level) guarded
+                            by control flow tainted by a per-rank value —
+                            rank id readbacks, ``process_index``,
+                            ``axis_index`` — the canonical SPMD deadlock:
+                            a subset of ranks enters the collective and
+                            every rank hangs
+                            (sanction: ``# graft-lint: divergence-ok``)
+- ``lock-order``            inconsistent lock-acquisition order between
+                            ``threading.Lock``/``RLock`` holders, nested
+                            acquisition of a non-reentrant lock, and
+                            blocking calls (queue puts, ``.join()``,
+                            device syncs) made while a lock is held
+                            (sanction: ``# graft-lint: lock-ok``)
+
+Like ``static_checks.py`` this module is deliberately **stdlib-only with
+no package imports** so ``tools/graft_lint.py`` can load it from the file
+path without importing ``deepspeed_tpu`` (and therefore jax).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+# lax collectives and the positional index of their axis-name argument.
+COLLECTIVE_AXIS_POS: Dict[str, int] = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "ppermute": 1, "all_to_all": 1, "pbroadcast": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+
+# Host-level (single-controller) collectives: every process must make the
+# same sequence of these calls, whatever the receiver is spelled as.
+HOST_COLLECTIVES = {
+    "barrier", "monitored_barrier", "sync_global_devices", "wait_at_barrier",
+    "all_gather_object", "broadcast_object_list", "process_allgather",
+    "broadcast_one_to_all", "all_reduce", "all_gather_into_tensor",
+    "reduce_scatter_tensor", "all_to_all_single",
+}
+
+# Mesh-entry constructs: their function argument gets the axes bound.
+BINDERS = {"shard_map", "pmap", "pjit"}
+
+# Per-rank taint sources: calls whose last dotted component matches one of
+# these (modulo leading underscores) yield values that differ across ranks.
+RANK_CALL_SUFFIXES = {
+    "process_index", "get_rank", "axis_index", "axis_rank", "local_rank",
+    "node_rank",
+}
+# ...and names that are uniform across ranks even though they look related.
+UNIFORM_CALL_SUFFIXES = {"process_count", "get_world_size", "device_count", "axis_size"}
+
+# Calls that block while a lock is held. ``.join`` excludes str/os.path
+# joins; ``.get``/``.wait`` are deliberately absent (dict.get, Condition.wait).
+BLOCKING_METHOD_ATTRS = {"put", "join", "result", "block_until_ready"}
+BLOCKING_CALL_SUFFIXES = {
+    "sleep", "device_get", "block_until_ready", "sync_global_devices",
+    "barrier", "monitored_barrier", "wait_at_barrier", "process_allgather",
+}
+
+SANCTIONS = {
+    "collective-axis": "graft-lint: axis-ok",
+    "divergent-collective": "graft-lint: divergence-ok",
+    "lock-order": "graft-lint: lock-ok",
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.check}] {self.message}"
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _literal_axes(node: Optional[ast.AST]) -> List[str]:
+    """String literals naming axes in an axis argument ('fsdp', ('data', 'fsdp'))."""
+    if node is None:
+        return []
+    s = _str_const(node)
+    if s is not None:
+        return [s]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            s = _str_const(e)
+            if s is not None:
+                out.append(s)
+        return out
+    return []
+
+
+def _sanctioned(lines: List[str], node: ast.AST, check: str) -> bool:
+    token = SANCTIONS.get(check)
+    if token is None:
+        return False
+    lo = getattr(node, "lineno", 0)
+    hi = getattr(node, "end_lineno", lo) or lo
+    for ln in range(lo, hi + 1):
+        if 1 <= ln <= len(lines) and token in lines[ln - 1]:
+            return True
+    return False
+
+
+def _function_nodes(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _walk_own(node: ast.AST):
+    """Walk a subtree, excluding nested function bodies (they are their own
+    call-graph / analysis nodes)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield sub
+        stack.extend(ast.iter_child_nodes(sub))
+
+
+def _last(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1] if dotted else ""
+
+
+# ---------------------------------------------------------------------------
+# mesh-axis vocabulary
+# ---------------------------------------------------------------------------
+
+def collect_mesh_axes(trees: Iterable[ast.AST]) -> Set[str]:
+    """Axis names declared anywhere in the linted trees: the ``ALL_AXES``
+    vocabulary tuple (parallel/mesh.py), literal ``Mesh(..., axis_names=...)``
+    sites, and ``jax.make_mesh(..., (axes...))`` sites."""
+    vocab: Set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                if any(isinstance(t, ast.Name) and t.id == "ALL_AXES" for t in targets):
+                    vocab.update(_literal_axes(node.value))
+            elif isinstance(node, ast.Call):
+                name = _last(_dotted(node.func)) or (
+                    node.func.attr if isinstance(node.func, ast.Attribute) else "")
+                if name == "Mesh":
+                    axis_arg = node.args[1] if len(node.args) > 1 else None
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            axis_arg = kw.value
+                    vocab.update(_literal_axes(axis_arg))
+                elif name == "make_mesh":
+                    if len(node.args) > 1:
+                        vocab.update(_literal_axes(node.args[1]))
+                    for kw in node.keywords:
+                        if kw.arg == "axis_names":
+                            vocab.update(_literal_axes(kw.value))
+    return vocab
+
+
+def _partition_spec_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to jax.sharding.PartitionSpec in this module."""
+    aliases = {"PartitionSpec"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "PartitionSpec":
+                    aliases.add(alias.asname or alias.name)
+        elif isinstance(node, ast.Assign) and isinstance(node.value, (ast.Name, ast.Attribute)):
+            if _last(_dotted(node.value)) == "PartitionSpec":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.add(t.id)
+    return aliases
+
+
+# ---------------------------------------------------------------------------
+# bound-context reachability (shard_map / pmap / pjit entry points)
+# ---------------------------------------------------------------------------
+
+def _referenced_names(fn: ast.AST) -> Set[str]:
+    """Names a function calls OR merely references (loaded). References
+    matter because functions travel through higher-order wrappers —
+    ``tree_map(leaf, ...)``, ``custom_vjp.defvjp(fwd, bwd)`` — and keep
+    their mesh-axis binding when called from a bound caller."""
+    out: Set[str] = set()
+    for node in _walk_own(fn):
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Name):
+                out.add(node.func.id)
+            elif isinstance(node.func, ast.Attribute):
+                out.add(node.func.attr)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            out.add(node.id)
+    return out
+
+
+def _expr_mentions_binder(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in BINDERS:
+            return True
+        if isinstance(sub, ast.Attribute) and sub.attr in BINDERS:
+            return True
+    return False
+
+
+def bound_functions(trees: Sequence[ast.AST]) -> Tuple[Set[str], bool]:
+    """(functions reachable from a mesh-binding entry point, whether any
+    binding site exists at all). When no shard_map/pmap/pjit site is in
+    scope — linting a leaf file — the unbound check is skipped entirely."""
+    defined: Set[str] = set()
+    edges: Dict[str, Set[str]] = {}
+    roots: Set[str] = set()
+    has_binding = False
+    for tree in trees:
+        for fn in _function_nodes(tree):
+            defined.add(fn.name)
+            edges.setdefault(fn.name, set()).update(_referenced_names(fn))
+            for deco in fn.decorator_list:
+                if _expr_mentions_binder(deco):
+                    has_binding = True
+                    roots.add(fn.name)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _last(_dotted(node.func)) in BINDERS:
+                has_binding = True
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        roots.add(arg.id)
+    seen: Set[str] = set()
+    frontier = [r for r in roots if r in defined]
+    while frontier:
+        name = frontier.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for ref in edges.get(name, ()):
+            if ref in defined and ref not in seen:
+                frontier.append(ref)
+    return seen, has_binding
+
+
+def _scoped_calls(tree: ast.AST):
+    """Yield (enclosing function name or None, Call node), attributing each
+    call to its innermost enclosing function."""
+    stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+    while stack:
+        node, fn = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.append((child, child.name))
+                continue
+            if isinstance(child, ast.Call):
+                yield fn, child
+            stack.append((child, fn))
+
+
+# ---------------------------------------------------------------------------
+# check 1: collective-axis
+# ---------------------------------------------------------------------------
+
+def _axis_arg(call: ast.Call, pos: int) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg in ("axis_name", "axis_names", "group"):
+            return kw.value
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def _is_lax_scoped(call: ast.Call) -> bool:
+    d = _dotted(call.func)
+    return "." not in d or d.startswith(("lax.", "jax.lax.", "jax."))
+
+
+def check_collective_axes(tree: ast.AST, path: str, lines: List[str],
+                          vocab: Set[str], bound: Set[str],
+                          has_binding: bool) -> List[Finding]:
+    findings: List[Finding] = []
+    known = ", ".join(sorted(vocab)) if vocab else ""
+    ps_aliases = _partition_spec_aliases(tree)
+
+    def flag(node: ast.AST, message: str) -> None:
+        if not _sanctioned(lines, node, "collective-axis"):
+            findings.append(Finding(path, node.lineno, "collective-axis", message))
+
+    for fn_name, call in _scoped_calls(tree):
+        name = _last(_dotted(call.func)) or (
+            call.func.attr if isinstance(call.func, ast.Attribute) else "")
+        if name in COLLECTIVE_AXIS_POS:
+            axes = _literal_axes(_axis_arg(call, COLLECTIVE_AXIS_POS[name]))
+            if vocab:
+                for ax in axes:
+                    if ax not in vocab:
+                        flag(call, f"axis '{ax}' passed to {name}() is not a declared "
+                                   f"mesh axis (known: {known})")
+            if axes and has_binding and _is_lax_scoped(call):
+                where = f"'{fn_name}'" if fn_name else "module scope"
+                if fn_name is None or fn_name not in bound:
+                    flag(call, f"{name}() over axis '{axes[0]}' in {where} is never "
+                               "entered via shard_map/pmap/pjit; the axis is unbound "
+                               "at trace time")
+        elif vocab and isinstance(call.func, (ast.Name, ast.Attribute)) \
+                and _last(_dotted(call.func)) in ps_aliases:
+            for arg in call.args:
+                for ax in _literal_axes(arg):
+                    if ax not in vocab:
+                        flag(call, f"PartitionSpec axis '{ax}' is not a declared "
+                                   f"mesh axis (known: {known})")
+
+    # parameter defaults: def all_reduce(x, group="data") — the default is
+    # the axis actually used by most call sites, so it gets vocabulary-checked.
+    if vocab:
+        for fn in _function_nodes(tree):
+            a = fn.args
+            pairs = list(zip(reversed(a.args), reversed(a.defaults)))
+            pairs += [(arg, d) for arg, d in zip(a.kwonlyargs, a.kw_defaults) if d is not None]
+            for arg, default in pairs:
+                if arg.arg not in ("axis_name", "axis_names", "group"):
+                    continue
+                for ax in _literal_axes(default):
+                    if ax not in vocab and not _sanctioned(lines, default, "collective-axis"):
+                        findings.append(Finding(
+                            path, default.lineno, "collective-axis",
+                            f"default axis '{ax}' of parameter '{arg.arg}' in "
+                            f"'{fn.name}' is not a declared mesh axis (known: {known})"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# check 2: divergent-collective
+# ---------------------------------------------------------------------------
+
+def _divergence_sink(call: ast.Call) -> Optional[str]:
+    name = _last(_dotted(call.func)) or (
+        call.func.attr if isinstance(call.func, ast.Attribute) else "")
+    if name in HOST_COLLECTIVES:
+        return name
+    if (name in COLLECTIVE_AXIS_POS or name in BINDERS) and _is_lax_scoped(call):
+        return name
+    return None
+
+
+class _DivergenceAnalyzer:
+    """Per-function walk: track names tainted by per-rank values, flag
+    collectives inside rank-dependent branches and after rank-guarded
+    early returns (the matched-barrier-missing pattern)."""
+
+    def __init__(self, fn, path: str, lines: List[str]):
+        self.fn = fn
+        self.path = path
+        self.lines = lines
+        self.findings: List[Finding] = []
+        self.tainted: Set[str] = {"RANK"}
+        for arg in list(fn.args.posonlyargs) + list(fn.args.args) + list(fn.args.kwonlyargs):
+            if arg.arg == "rank":
+                self.tainted.add("rank")
+
+    def run(self) -> List[Finding]:
+        self._walk(list(self.fn.body))
+        return self.findings
+
+    # -------------------------------------------------------------- taint
+    def _tainted_expr(self, node: Optional[ast.AST]) -> bool:
+        if node is None:
+            return False
+        if isinstance(node, ast.Name):
+            return node.id in self.tainted
+        if isinstance(node, ast.Call):
+            last = _last(_dotted(node.func)) or (
+                node.func.attr if isinstance(node.func, ast.Attribute) else "")
+            bare = last.lstrip("_")
+            if bare in UNIFORM_CALL_SUFFIXES:
+                return False
+            if bare in RANK_CALL_SUFFIXES:
+                return True
+            return any(self._tainted_expr(a) for a in node.args)
+        if isinstance(node, ast.Compare):
+            return self._tainted_expr(node.left) or any(
+                self._tainted_expr(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self._tainted_expr(v) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return self._tainted_expr(node.left) or self._tainted_expr(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted_expr(node.operand)
+        if isinstance(node, ast.IfExp):
+            return (self._tainted_expr(node.test) or self._tainted_expr(node.body)
+                    or self._tainted_expr(node.orelse))
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("rank", "global_rank", "local_rank", "node_rank",
+                            "process_index", "process_id"):
+                return True
+            return self._tainted_expr(node.value)
+        if isinstance(node, (ast.Subscript, ast.Starred)):
+            return self._tainted_expr(node.value)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._tainted_expr(e) for e in node.elts)
+        return False
+
+    def _assign_names(self, target: ast.AST) -> List[str]:
+        if isinstance(target, (ast.Tuple, ast.List)):
+            out: List[str] = []
+            for e in target.elts:
+                out.extend(self._assign_names(e))
+            return out
+        if isinstance(target, ast.Name):
+            return [target.id]
+        return []
+
+    def _update_taint(self, stmt: ast.stmt) -> None:
+        targets: List[ast.AST] = []
+        value: Optional[ast.AST] = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = list(stmt.targets), stmt.value
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        if value is None:
+            return
+        is_tainted = self._tainted_expr(value)
+        for t in targets:
+            for name in self._assign_names(t):
+                (self.tainted.add if is_tainted else self.tainted.discard)(name)
+
+    # -------------------------------------------------------------- sinks
+    def _flag_sinks(self, node: ast.AST, guard_line: int, reason: str) -> None:
+        stack: List[ast.AST] = [node]
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(sub, ast.Call):
+                sink = _divergence_sink(sub)
+                if sink is not None and not _sanctioned(self.lines, sub, "divergent-collective"):
+                    self.findings.append(Finding(
+                        self.path, sub.lineno, "divergent-collective",
+                        f"collective '{sink}' {reason} (rank guard at line "
+                        f"{guard_line}); a subset of ranks enters it and every "
+                        "rank hangs"))
+            stack.extend(ast.iter_child_nodes(sub))
+
+    @staticmethod
+    def _terminal(body: Sequence[ast.stmt]) -> bool:
+        return bool(body) and isinstance(
+            body[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    # -------------------------------------------------------------- walk
+    def _walk(self, body: Sequence[ast.stmt]) -> None:
+        divergent_since: Optional[int] = None
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if divergent_since is not None:
+                self._flag_sinks(stmt, divergent_since,
+                                 "after a rank-guarded early return")
+                self._update_taint(stmt)
+                continue
+            if isinstance(stmt, (ast.If, ast.While)) and self._tainted_expr(stmt.test):
+                for sub in list(stmt.body) + list(stmt.orelse):
+                    self._flag_sinks(sub, stmt.lineno,
+                                     "inside a branch on a per-rank value")
+                if isinstance(stmt, ast.If) and self._terminal(stmt.body) \
+                        and not stmt.orelse:
+                    divergent_since = stmt.lineno
+                continue
+            if isinstance(stmt, (ast.For, ast.AsyncFor)) and self._tainted_expr(stmt.iter):
+                for sub in list(stmt.body) + list(stmt.orelse):
+                    self._flag_sinks(sub, stmt.lineno,
+                                     "inside a loop over a per-rank value")
+                continue
+            self._update_taint(stmt)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                    self._walk(sub)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk(handler.body)
+
+
+def check_divergence(tree: ast.AST, path: str, lines: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn in _function_nodes(tree):
+        findings.extend(_DivergenceAnalyzer(fn, path, lines).run())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# check 3: lock-order / blocking-under-lock
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class _LockEdge:
+    held: str      # token of the lock already held
+    acquired: str  # token of the lock acquired under it
+    path: str
+    line: int
+
+
+class _LockAnalysis:
+    """Cross-module static lock graph. Lock identity is name-based
+    (``Class.attr`` / ``module.name``): precise enough for the project's
+    locks, which are created once in ``__init__`` and held briefly."""
+
+    def __init__(self) -> None:
+        self.kinds: Dict[str, str] = {}      # token -> "Lock" | "RLock"
+        self.edges: List[_LockEdge] = []
+        self.edge_nodes: List[ast.AST] = []
+        self.blocking: List[Tuple[str, str, str, int, ast.AST]] = []
+        # (class, method) -> tokens acquired directly inside that method
+        self.method_locks: Dict[Tuple[str, str], Set[str]] = {}
+        self._lines: Dict[str, List[str]] = {}
+
+    # ------------------------------------------------------------ identity
+    @staticmethod
+    def _lock_ctor(node: ast.AST) -> Optional[str]:
+        if isinstance(node, ast.Call):
+            name = _last(_dotted(node.func)) or (
+                node.func.attr if isinstance(node.func, ast.Attribute) else "")
+            if name in ("Lock", "RLock"):
+                return name
+        return None
+
+    def _register_locks(self, tree: ast.AST, modname: str) -> None:
+        def scope_of(cls: Optional[str]) -> str:
+            return cls if cls is not None else modname
+
+        stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+        while stack:
+            node, cls = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name))
+                    continue
+                if isinstance(child, ast.Assign):
+                    kind = self._lock_ctor(child.value)
+                    if kind is not None:
+                        for t in child.targets:
+                            if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                                    and t.value.id == "self":
+                                self.kinds[f"{scope_of(cls)}.{t.attr}"] = kind
+                            elif isinstance(t, ast.Name):
+                                self.kinds[f"{scope_of(cls)}.{t.id}"] = kind
+                stack.append((child, cls))
+
+    def _token_of(self, expr: ast.AST, cls: Optional[str], modname: str) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            token = f"{cls or modname}.{expr.attr}"
+            if token in self.kinds or "lock" in expr.attr.lower():
+                return token
+        elif isinstance(expr, ast.Name):
+            token = f"{modname}.{expr.id}"
+            if token in self.kinds or "lock" in expr.id.lower():
+                return token
+        return None
+
+    # ------------------------------------------------------------ passes
+    def scan(self, trees: Dict[str, ast.AST], sources: Dict[str, str]) -> None:
+        mods = {path: os.path.splitext(os.path.basename(path))[0] for path in trees}
+        for path, tree in trees.items():
+            self._lines[path] = sources[path].splitlines()
+            self._register_locks(tree, mods[path])
+        # pass 1: which tokens does each method acquire directly?
+        for path, tree in trees.items():
+            for cls, fn in self._methods(tree):
+                tokens: Set[str] = set()
+                for node in _walk_own_with(fn):
+                    for item in node.items:
+                        tok = self._token_of(item.context_expr, cls, mods[path])
+                        if tok is not None:
+                            tokens.add(tok)
+                if tokens:
+                    self.method_locks[(cls or mods[path], fn.name)] = tokens
+        # pass 2: edges + blocking calls with held-set context
+        for path, tree in trees.items():
+            for cls, fn in self._methods(tree):
+                self._walk_held(list(fn.body), (), cls, mods[path], path)
+
+    @staticmethod
+    def _methods(tree: ast.AST):
+        stack: List[Tuple[ast.AST, Optional[str]]] = [(tree, None)]
+        while stack:
+            node, cls = stack.pop()
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    stack.append((child, child.name))
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield cls, child
+                    stack.append((child, cls))
+                else:
+                    stack.append((child, cls))
+
+    def _walk_held(self, body: Sequence[ast.stmt], held: Tuple[str, ...],
+                   cls: Optional[str], modname: str, path: str) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            new_held = held
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired = []
+                for item in stmt.items:
+                    tok = self._token_of(item.context_expr, cls, modname)
+                    if tok is not None:
+                        acquired.append(tok)
+                for tok in acquired:
+                    for h in new_held:
+                        self.edges.append(_LockEdge(h, tok, path, stmt.lineno))
+                        self.edge_nodes.append(stmt)
+                    new_held = new_held + (tok,)
+            if held or (new_held != held):
+                self._scan_stmt_calls(stmt, new_held if new_held else held,
+                                      cls, modname, path)
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub and isinstance(sub[0], ast.stmt):
+                    self._walk_held(sub, new_held, cls, modname, path)
+            for handler in getattr(stmt, "handlers", []) or []:
+                self._walk_held(handler.body, new_held, cls, modname, path)
+
+    def _scan_stmt_calls(self, stmt: ast.stmt, held: Tuple[str, ...],
+                         cls: Optional[str], modname: str, path: str) -> None:
+        """Blocking calls and same-class method call edges in the header (or
+        whole simple statement) of ``stmt``, with ``held`` locks."""
+        if not held:
+            return
+        compound = isinstance(stmt, (ast.If, ast.While, ast.For, ast.AsyncFor,
+                                     ast.With, ast.AsyncWith, ast.Try))
+        if compound:
+            scans: List[ast.AST] = []
+            header = getattr(stmt, "test", None) or getattr(stmt, "iter", None)
+            if header is not None:
+                scans.append(header)
+            for item in getattr(stmt, "items", []) or []:
+                scans.append(item.context_expr)
+        else:
+            scans = [stmt]
+        for scan in scans:
+            for node in ast.walk(scan):
+                if not isinstance(node, ast.Call):
+                    continue
+                self._handle_call(node, held, cls, modname, path)
+
+    def _handle_call(self, call: ast.Call, held: Tuple[str, ...],
+                     cls: Optional[str], modname: str, path: str) -> None:
+        d = _dotted(call.func)
+        name = _last(d) or (call.func.attr if isinstance(call.func, ast.Attribute) else "")
+        # same-class method call: propagate its direct acquisitions as edges
+        if isinstance(call.func, ast.Attribute) and isinstance(call.func.value, ast.Name) \
+                and call.func.value.id == "self":
+            for tok in self.method_locks.get((cls or modname, call.func.attr), ()):
+                for h in held:
+                    self.edges.append(_LockEdge(h, tok, path, call.lineno))
+                    self.edge_nodes.append(call)
+        # blocking calls under a lock
+        is_blocking = False
+        if isinstance(call.func, ast.Attribute) and call.func.attr in BLOCKING_METHOD_ATTRS:
+            if call.func.attr == "join" and (
+                    ".path." in d or d.startswith("path.")
+                    or isinstance(call.func.value, ast.Constant)):
+                is_blocking = False  # os.path.join / ", ".join
+            elif call.func.attr == "put" and call.keywords is not None and any(
+                    kw.arg == "block" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is False for kw in call.keywords):
+                is_blocking = False  # q.put(x, block=False)
+            else:
+                is_blocking = True
+        elif name in BLOCKING_CALL_SUFFIXES:
+            is_blocking = True
+        if is_blocking:
+            desc = d or name
+            self.blocking.append((desc, held[-1], path, call.lineno, call))
+
+    # ------------------------------------------------------------ findings
+    def findings(self) -> List[Finding]:
+        out: List[Finding] = []
+        adj: Dict[str, Set[str]] = {}
+        for e in self.edges:
+            adj.setdefault(e.held, set()).add(e.acquired)
+
+        def reaches(src: str, dst: str) -> bool:
+            seen: Set[str] = set()
+            frontier = [src]
+            while frontier:
+                cur = frontier.pop()
+                if cur == dst:
+                    return True
+                if cur in seen:
+                    continue
+                seen.add(cur)
+                frontier.extend(adj.get(cur, ()))
+            return False
+
+        reported: Set[Tuple[str, int, str, str]] = set()
+        for e, node in zip(self.edges, self.edge_nodes):
+            lines = self._lines.get(e.path, [])
+            if e.held == e.acquired:
+                if self.kinds.get(e.acquired) == "Lock" \
+                        and not _sanctioned(lines, node, "lock-order"):
+                    key = (e.path, e.line, e.held, e.acquired)
+                    if key not in reported:
+                        reported.add(key)
+                        out.append(Finding(
+                            e.path, e.line, "lock-order",
+                            f"nested acquisition of non-reentrant lock "
+                            f"'{e.acquired}' deadlocks; use RLock or restructure"))
+                continue
+            if reaches(e.acquired, e.held):
+                other = next((o for o in self.edges
+                              if o.held == e.acquired or
+                              (o.acquired == e.held and o.held != e.held)), None)
+                if _sanctioned(lines, node, "lock-order"):
+                    continue
+                key = (e.path, e.line, e.held, e.acquired)
+                if key in reported:
+                    continue
+                reported.add(key)
+                where = f" (reverse order at {os.path.basename(other.path)}:{other.line})" \
+                    if other is not None else ""
+                out.append(Finding(
+                    e.path, e.line, "lock-order",
+                    f"lock '{e.acquired}' acquired while holding '{e.held}'"
+                    f"{where}; inconsistent acquisition order can deadlock"))
+        for desc, tok, path, line, node in self.blocking:
+            lines = self._lines.get(path, [])
+            if _sanctioned(lines, node, "lock-order"):
+                continue
+            out.append(Finding(
+                path, line, "lock-order",
+                f"blocking call '{desc}()' while holding lock '{tok}'; queue "
+                "puts, joins, and device syncs do not belong under a lock"))
+        return out
+
+
+def _walk_own_with(fn: ast.AST):
+    """With statements in a function body, nested defs excluded."""
+    stack = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            yield node
+        for child in ast.iter_child_nodes(node):
+            stack.append(child)
+
+
+def check_locks(trees: Dict[str, ast.AST], sources: Dict[str, str]) -> List[Finding]:
+    analysis = _LockAnalysis()
+    analysis.scan(trees, sources)
+    return analysis.findings()
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str], *,
+               mesh_axes: Optional[Iterable[str]] = None) -> List[Finding]:
+    files = _iter_py_files(paths)
+    sources: Dict[str, str] = {}
+    trees: Dict[str, ast.AST] = {}
+    findings: List[Finding] = []
+    for f in files:
+        with open(f, "r", encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            trees[f] = ast.parse(src, filename=f)
+        except SyntaxError as e:
+            findings.append(Finding(f, e.lineno or 0, "parse", f"syntax error: {e.msg}"))
+            continue
+        sources[f] = src
+    findings.extend(_lint_trees(trees, sources, mesh_axes=mesh_axes))
+    findings.sort(key=lambda x: (x.path, x.line, x.check))
+    return findings
+
+
+def _lint_trees(trees: Dict[str, ast.AST], sources: Dict[str, str], *,
+                mesh_axes: Optional[Iterable[str]] = None) -> List[Finding]:
+    vocab = set(mesh_axes) if mesh_axes is not None \
+        else collect_mesh_axes(trees.values())
+    bound, has_binding = bound_functions(list(trees.values()))
+    findings: List[Finding] = []
+    for path, tree in trees.items():
+        lines = sources[path].splitlines()
+        findings.extend(check_collective_axes(tree, path, lines, vocab,
+                                              bound, has_binding))
+        findings.extend(check_divergence(tree, path, lines))
+    findings.extend(check_locks(trees, sources))
+    return findings
+
+
+def lint_source(source: str, path: str = "<string>", *,
+                mesh_axes: Optional[Iterable[str]] = None) -> List[Finding]:
+    """Single-source entry point used by the fixture unit tests. With
+    ``mesh_axes=None`` the vocabulary is recovered from the source itself."""
+    tree = ast.parse(source, filename=path)
+    out = _lint_trees({path: tree}, {path: source}, mesh_axes=mesh_axes)
+    out.sort(key=lambda x: (x.path, x.line, x.check))
+    return out

@@ -161,6 +161,11 @@ declare("DS_TPU_TRANSFER_GUARD", "0", "bool",
         "Run fused/spec dispatch under jax.transfer_guard_device_to_host('disallow') "
         "so implicit host readbacks raise instead of silently syncing.",
         "analysis/transfer_guard.py")
+declare("DS_TPU_COMM_AUDIT", "0", "bool",
+        "Record every collective into a per-rank (op, dtype, shape, axis) ledger "
+        "and cross-check ledgers at barrier points, raising a structured "
+        "divergence report instead of hanging on a mismatched collective.",
+        "analysis/comm_audit.py")
 
 # Telemetry (telemetry/)
 declare("DS_TPU_TELEMETRY", "1", "bool",

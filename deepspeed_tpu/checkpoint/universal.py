@@ -84,7 +84,9 @@ def _write_universal(out_dir: str, tag: str, params_flat: Dict[str, np.ndarray],
         # but nobody returns until the write is durable (barrier below)
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(f"universal_save:{tag}")
+        # matched pair: rank 0 reaches the same barrier at the end of the
+        # write path below, so every rank passes exactly one
+        multihost_utils.sync_global_devices(f"universal_save:{tag}")  # graft-lint: divergence-ok
         return root
     # stage into a tmp dir and rename: a reader (or a preempted writer)
     # never sees a half-written checkpoint under the final name
@@ -129,7 +131,8 @@ def _write_universal(out_dir: str, tag: str, params_flat: Dict[str, np.ndarray],
         # rank (and external watchers) sees the completed checkpoint
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(f"universal_save:{tag}")
+        # matched pair with the non-zero-rank early-return barrier above
+        multihost_utils.sync_global_devices(f"universal_save:{tag}")  # graft-lint: divergence-ok
     return root
 
 

@@ -15,9 +15,19 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..analysis import comm_audit
 from .reduce_op import ReduceOp
 
 AxisName = Union[str, Sequence[str]]
+
+
+def _audit(op: str, tensor, axis: AxisName) -> None:
+    """Trace-time choreography recording (DS_TPU_COMM_AUDIT): runs once per
+    trace, never in the compiled program, so the serving path stays free."""
+    aud = comm_audit.get_auditor()
+    if aud is not None:
+        aud.record(op, str(getattr(tensor, "dtype", "")),
+                   tuple(getattr(tensor, "shape", ()) or ()), axis=str(axis))
 
 
 def _psum_like(tensor, axis_name: AxisName, op: ReduceOp):
@@ -37,6 +47,7 @@ def _psum_like(tensor, axis_name: AxisName, op: ReduceOp):
 
 def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = "data"):
     """Reference ``comm.py:483``. Sum (or max/min/avg) across the axis."""
+    _audit("all_reduce", tensor, group)
     return _psum_like(tensor, group, op)
 
 
@@ -48,10 +59,12 @@ def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = 
 def all_gather_into_tensor(tensor, group: AxisName = "data", axis: int = 0, tiled: bool = True):
     """Gather shards along ``axis`` from every member; result is the
     concatenation (``tiled=True``, torch semantics) or stacked (False)."""
+    _audit("all_gather_into_tensor", tensor, group)
     return lax.all_gather(tensor, group, axis=axis, tiled=tiled)
 
 
 def all_gather(tensor, group: AxisName = "data", axis: int = 0):
+    _audit("all_gather", tensor, group)
     return lax.all_gather(tensor, group, axis=axis, tiled=True)
 
 
@@ -59,6 +72,7 @@ def reduce_scatter_tensor(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName =
     """Reference ``comm.py:280``. Sum across members, scatter along ``axis``."""
     if op not in (ReduceOp.SUM, ReduceOp.AVG):
         raise NotImplementedError("reduce_scatter supports SUM/AVG")
+    _audit("reduce_scatter_tensor", tensor, group)
     out = lax.psum_scatter(tensor, group, scatter_dimension=axis, tiled=True)
     if op == ReduceOp.AVG:
         out = out / lax.psum(jnp.ones((), dtype=out.dtype), group)
@@ -69,6 +83,7 @@ def all_to_all_single(tensor, group: AxisName = "seq", split_axis: int = 0, conc
     """Reference ``comm.py:331``. Split ``split_axis`` into group-size chunks,
     exchange chunk i with member i, concatenate received chunks on
     ``concat_axis``."""
+    _audit("all_to_all_single", tensor, group)
     return lax.all_to_all(tensor, group, split_axis=split_axis, concat_axis=concat_axis, tiled=True)
 
 
@@ -92,6 +107,7 @@ def reduce(tensor, dst: int = 0, op: ReduceOp = ReduceOp.SUM, group: AxisName = 
 
 
 def ppermute(tensor, perm, group: AxisName = "pipe"):
+    _audit("ppermute", tensor, group)
     return lax.ppermute(tensor, group, perm)
 
 

@@ -25,7 +25,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
-from ..analysis import knobs
+from ..analysis import comm_audit, knobs
 from ..telemetry.registry import get_registry
 from ..utils.comms_logging import CommsLogger, get_caller_func
 from ..utils.logging import logger
@@ -110,10 +110,33 @@ def new_group(ranks=None):
         "deepspeed_tpu has no dynamic process groups: declare parallel dims as mesh axes (config 'mesh' section)")
 
 
+def _audit_record(op: str, tensor=None, axis: str = "") -> None:
+    aud = comm_audit.get_auditor()
+    if aud is not None:
+        aud.record(op, str(getattr(tensor, "dtype", "")),
+                   tuple(getattr(tensor, "shape", ()) or ()), axis=axis)
+
+
+def _audit_check(log_name: str) -> None:
+    """Cross-check every rank's collective ledger BEFORE entering the device
+    barrier: a divergence raises a one-line diagnosis here instead of
+    wedging inside the collective. ``all_gather_object`` pads ragged
+    payloads, so this exchange itself cannot hang on a mismatch."""
+    aud = comm_audit.get_auditor()
+    if aud is None or jax.process_count() <= 1:
+        return
+    ledgers = all_gather_object(aud.entries())
+    report = comm_audit.cross_check(ledgers)
+    if report is not None:
+        raise comm_audit.CommChoreographyError(report, barrier=log_name)
+
+
 def barrier(group=None, log_name: str = "barrier"):
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
+        _audit_check(log_name)
+        _audit_record(f"barrier:{log_name}")
         multihost_utils.sync_global_devices(log_name)
     else:
         (jnp.zeros(()) + 0).block_until_ready()
@@ -147,6 +170,8 @@ def monitored_barrier(group=None, timeout: Optional[float] = None, wait_all_rank
         _monitored_barrier_warned.append(True)
         logger.warning("monitored_barrier: wait_all_ranks is accepted for signature parity but the "
                        "coordination service reports the first missing peer only")
+    _audit_check(log_name)
+    _audit_record(f"monitored_barrier:{log_name}")
     _monitored_barrier_seq[0] += 1
     barrier_id = f"ds_tpu_{log_name}_{_monitored_barrier_seq[0]}"
     try:
@@ -182,6 +207,7 @@ def _timed(raw_name):
             msg = int(getattr(tensor, "size", 0)) * int(getattr(tensor, "dtype", jnp.float32).itemsize)
             _m_ops.inc()
             _m_bytes.inc(msg)
+            _audit_record(raw_name, tensor)
             prof = comms_logger.should_profile(raw_name)
             if not prof:
                 return fn(tensor, *args, **kwargs)

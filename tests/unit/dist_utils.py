@@ -21,6 +21,14 @@ _PREAMBLE = """
 import os, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+try:
+    # Cross-process computations on the CPU backend need an explicit
+    # collectives implementation (gloo-over-TCP); without it every
+    # multi-process collective fails with "Multiprocess computations
+    # aren't implemented on the CPU backend".
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+except Exception:
+    pass  # older jaxlib: single-option backend, nothing to select
 jax.distributed.initialize(coordinator_address=os.environ["DS_TEST_COORD"],
                            num_processes=int(os.environ["DS_TEST_NPROCS"]),
                            process_id=int(os.environ["DS_TEST_PROC_ID"]))

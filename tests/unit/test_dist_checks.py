@@ -1,0 +1,638 @@
+"""graft-lint/dist: fixture tests per check, choreography auditor, CI gate.
+
+The static checker (``deepspeed_tpu/analysis/dist_checks.py``) is
+stdlib-only and is loaded from its file path exactly the way
+``tools/graft_lint.py`` loads it — the fixture tests never import jax.
+The choreography-auditor tests import the package (no jax needed for the
+ledger itself) and the two-rank test forks real processes.
+"""
+
+import importlib.util
+import json
+import pathlib
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parents[2]
+DIST_CHECKS_PATH = ROOT / "deepspeed_tpu" / "analysis" / "dist_checks.py"
+TOOL = str(ROOT / "tools" / "graft_lint.py")
+
+
+def _load_dist_checks():
+    spec = importlib.util.spec_from_file_location(
+        "graft_lint_dist_checks_test", str(DIST_CHECKS_PATH))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+dist_checks = _load_dist_checks()
+
+
+def lint(src, **kw):
+    return dist_checks.lint_source(textwrap.dedent(src), **kw)
+
+
+def by_check(findings, name):
+    return [f for f in findings if f.check == name]
+
+
+# ------------------------------------------------------------ collective-axis
+class TestCollectiveAxis:
+
+    def test_unknown_literal_axis_flagged(self):
+        out = lint("""
+            from jax import lax
+            def step(x):
+                return lax.psum(x, "modle")
+            def run(x, jax, m):
+                return jax.shard_map(step, mesh=m)(x)
+        """, mesh_axes=("data", "model"))
+        hits = by_check(out, "collective-axis")
+        assert any(h.line == 4 and "'modle'" in h.message for h in hits)
+
+    def test_known_axis_in_bound_function_clean(self):
+        out = lint("""
+            from jax import lax
+            def step(x):
+                return lax.psum(x, ("data", "fsdp"))
+            def run(x, jax, m):
+                return jax.shard_map(step, mesh=m)(x)
+        """, mesh_axes=("data", "fsdp"))
+        assert not by_check(out, "collective-axis")
+
+    def test_vocabulary_recovered_from_all_axes_and_mesh_literal(self):
+        out = lint("""
+            from jax import lax
+            from jax.sharding import Mesh
+            ALL_AXES = ("data",)
+            def step(x):
+                return lax.psum(x, "model")
+            def run(x, jax, grid):
+                m = Mesh(grid, ("model",))
+                return jax.shard_map(step, mesh=m)(x)
+        """)
+        assert not by_check(out, "collective-axis")
+
+    def test_unbound_collective_flagged(self):
+        out = lint("""
+            from jax import lax
+            def bound(x):
+                return lax.psum(x, "data")
+            def loose(x):
+                return lax.pmean(x, "data")
+            def run(x, jax, m):
+                return jax.shard_map(bound, mesh=m)(x)
+        """, mesh_axes=("data",))
+        hits = by_check(out, "collective-axis")
+        assert len(hits) == 1 and hits[0].line == 6
+        assert "shard_map" in hits[0].message
+
+    def test_reference_edges_keep_higher_order_callees_bound(self):
+        # leaf never appears in a Call node — it travels through tree_map —
+        # but it is still mesh-bound because run (shard_map target) refs it
+        out = lint("""
+            from jax import lax
+            def leaf(g):
+                return lax.psum(g, "fsdp")
+            def run(tree, tree_map):
+                return tree_map(leaf, tree)
+            def main(x, jax, m, tree_map):
+                return jax.shard_map(run, mesh=m)(x, tree_map)
+        """, mesh_axes=("fsdp",))
+        assert not by_check(out, "collective-axis")
+
+    def test_no_binding_sites_skips_unbound_check(self):
+        out = lint("""
+            from jax import lax
+            def helper(x):
+                return lax.psum(x, "data")
+        """, mesh_axes=("data",))
+        assert not by_check(out, "collective-axis")
+
+    def test_partition_spec_axis_checked(self):
+        out = lint("""
+            from jax.sharding import PartitionSpec as P
+            spec = P("tensr", None)
+            ok = P("tensor", "data")
+        """, mesh_axes=("tensor", "data"))
+        hits = by_check(out, "collective-axis")
+        assert len(hits) == 1 and hits[0].line == 3 and "PartitionSpec" in hits[0].message
+
+    def test_parameter_default_axis_checked(self):
+        out = lint("""
+            from jax import lax
+            def all_reduce(x, group="tnsor"):
+                return lax.psum(x, group)
+        """, mesh_axes=("tensor",))
+        hits = by_check(out, "collective-axis")
+        assert len(hits) == 1 and "default axis 'tnsor'" in hits[0].message
+
+    def test_sanction_comment_accepted(self):
+        out = lint("""
+            from jax import lax
+            def step(x):
+                return lax.psum(x, "weird")  # graft-lint: axis-ok
+            def run(x, jax, m):
+                return jax.shard_map(step, mesh=m)(x)
+        """, mesh_axes=("data",))
+        assert not by_check(out, "collective-axis")
+
+    def test_non_lax_receiver_vocab_checked_but_binding_exempt(self):
+        # topo.axis_size("fsdp") is a host-side mesh query, not a collective:
+        # vocabulary typos still flag, but no shard_map binding is required
+        out = lint("""
+            def plan(topo):
+                return topo.axis_size("fsdp")
+            def run(x, jax, m, f):
+                return jax.shard_map(f, mesh=m)(x)
+        """, mesh_axes=("fsdp",))
+        assert not by_check(out, "collective-axis")
+        out = lint("""
+            def plan(topo):
+                return topo.axis_size("fdsp")
+        """, mesh_axes=("fsdp",))
+        assert len(by_check(out, "collective-axis")) == 1
+
+
+# ------------------------------------------------------- divergent-collective
+class TestDivergentCollective:
+
+    def test_collective_in_rank_branch_flagged(self):
+        out = lint("""
+            import jax
+            def save(x, dist):
+                if jax.process_index() == 0:
+                    dist.barrier()
+                return x
+        """)
+        hits = by_check(out, "divergent-collective")
+        assert len(hits) == 1 and hits[0].line == 5
+        assert "rank guard at line 4" in hits[0].message
+
+    def test_collective_after_rank_guarded_early_return_flagged(self):
+        out = lint("""
+            def save(x, dist):
+                rank = dist.get_rank()
+                if rank != 0:
+                    return None
+                write(x)
+                dist.all_reduce(x)
+        """)
+        hits = by_check(out, "divergent-collective")
+        assert len(hits) == 1 and hits[0].line == 7
+        assert "early return" in hits[0].message
+
+    def test_uniform_condition_not_flagged(self):
+        out = lint("""
+            import jax
+            def save(x, dist):
+                if jax.process_count() > 1:
+                    dist.barrier()
+                return x
+        """)
+        assert not by_check(out, "divergent-collective")
+
+    def test_shard_map_entry_under_rank_guard_flagged(self):
+        out = lint("""
+            import jax
+            def run(x, f, m):
+                if jax.process_index() == 0:
+                    return jax.shard_map(f, mesh=m)(x)
+                return x
+        """)
+        assert len(by_check(out, "divergent-collective")) == 1
+
+    def test_taint_propagates_through_assignment(self):
+        out = lint("""
+            import jax
+            def save(x, dist):
+                r = jax.process_index()
+                lead = r == 0
+                if lead:
+                    dist.monitored_barrier()
+        """)
+        assert len(by_check(out, "divergent-collective")) == 1
+
+    def test_sanction_comment_accepted(self):
+        out = lint("""
+            import jax
+            def save(x, dist):
+                if jax.process_index() != 0:
+                    dist.barrier()  # graft-lint: divergence-ok
+                    return x
+                write(x)
+                dist.barrier()  # graft-lint: divergence-ok
+        """)
+        assert not by_check(out, "divergent-collective")
+
+    def test_non_collective_rank_branch_not_flagged(self):
+        out = lint("""
+            import jax
+            def log_once(msg, logger):
+                if jax.process_index() == 0:
+                    logger.info(msg)
+        """)
+        assert not by_check(out, "divergent-collective")
+
+
+# ------------------------------------------------------------------ lock-order
+class TestLockOrder:
+
+    def test_inconsistent_order_flagged_at_both_sites(self):
+        out = lint("""
+            import threading
+            class A:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def two(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        hits = by_check(out, "lock-order")
+        assert {h.line for h in hits} == {9, 13}
+        assert all("inconsistent" in h.message for h in hits)
+
+    def test_consistent_order_clean(self):
+        out = lint("""
+            import threading
+            class A:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+                def one(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+                def two(self):
+                    with self._a_lock:
+                        with self._b_lock:
+                            pass
+        """)
+        assert not by_check(out, "lock-order")
+
+    def test_cross_method_edge_detected(self):
+        out = lint("""
+            import threading
+            class A:
+                def __init__(self):
+                    self._a_lock = threading.Lock()
+                    self._b_lock = threading.Lock()
+                def one(self):
+                    with self._a_lock:
+                        self.grab_b()
+                def grab_b(self):
+                    with self._b_lock:
+                        pass
+                def two(self):
+                    with self._b_lock:
+                        with self._a_lock:
+                            pass
+        """)
+        hits = by_check(out, "lock-order")
+        assert hits, "call-graph lock edge missed"
+
+    def test_nested_nonreentrant_lock_flagged(self):
+        out = lint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+                def inner(self):
+                    with self._lock:
+                        pass
+        """)
+        hits = by_check(out, "lock-order")
+        assert len(hits) == 1 and "non-reentrant" in hits[0].message
+
+    def test_rlock_nesting_clean(self):
+        out = lint("""
+            import threading
+            class C:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                def outer(self):
+                    with self._lock:
+                        self.inner()
+                def inner(self):
+                    with self._lock:
+                        pass
+        """)
+        assert not by_check(out, "lock-order")
+
+    def test_blocking_calls_under_lock_flagged(self):
+        out = lint("""
+            import threading
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = None
+                def go(self, t, x):
+                    with self._lock:
+                        self._q.put(1)
+                        t.join()
+                        x.block_until_ready()
+        """)
+        hits = by_check(out, "lock-order")
+        assert {h.line for h in hits} == {9, 10, 11}
+        assert all("blocking call" in h.message for h in hits)
+
+    def test_nonblocking_variants_and_outside_lock_clean(self):
+        out = lint("""
+            import os, threading
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = None
+                def go(self, parts, t):
+                    with self._lock:
+                        self._q.put_nowait(1)
+                        self._q.put(2, block=False)
+                        p = os.path.join(*parts)
+                        s = ", ".join(parts)
+                    self._q.put(3)
+                    t.join()
+        """)
+        assert not by_check(out, "lock-order")
+
+    def test_sanction_comment_accepted(self):
+        out = lint("""
+            import threading
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._q = None
+                def go(self):
+                    with self._lock:
+                        self._q.put(1)  # graft-lint: lock-ok
+        """)
+        assert not by_check(out, "lock-order")
+
+
+# ------------------------------------------------- planted-violation location
+def test_planted_violations_all_flagged_with_location():
+    """One source planting all three dist check classes: each reported with
+    the right file:line, and the clean lines stay clean."""
+    src = textwrap.dedent("""\
+        import threading
+        from jax import lax
+
+        ALL_AXES = ("data", "tensor")
+
+        def entry(x):
+            return shard_map(inner, mesh=None)(x)
+
+        def inner(x):
+            return lax.psum(x, "data")
+
+        def loose(x):
+            return lax.pmean(x, "modle")
+
+        def guarded(x, dist):
+            if dist.get_rank() == 0:
+                dist.barrier()
+            return x
+
+        class Locks:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+            def one(self, q):
+                with self._a_lock:
+                    with self._b_lock:
+                        q.put(1)
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+    """)
+    out = dist_checks.lint_source(src, path="planted.py")
+    got = {(f.check, f.line) for f in out}
+    assert ("collective-axis", 13) in got        # unknown axis (and unbound)
+    assert ("divergent-collective", 17) in got
+    assert ("lock-order", 26) in got             # a->b inversion
+    assert ("lock-order", 27) in got             # q.put under two locks
+    assert ("lock-order", 30) in got             # b->a inversion
+    assert not any(ln == 10 for _c, ln in got), "bound collective wrongly flagged"
+    assert all(f.path == "planted.py" for f in out)
+
+
+# --------------------------------------------------------------- CLI surface
+def _write_divergent_module(path):
+    path.write_text(textwrap.dedent("""
+        import jax
+        def save(x, dist):
+            if jax.process_index() == 0:
+                dist.barrier()
+            return x
+    """))
+
+
+def test_json_output_schema(tmp_path):
+    """--json: one JSON object per line with exactly the documented keys;
+    baselined findings carry sanctioned=true."""
+    bad = tmp_path / "mod.py"
+    _write_divergent_module(bad)
+    baseline = tmp_path / "baseline.txt"
+    subprocess.run([sys.executable, TOOL, str(bad), "--baseline", str(baseline),
+                    "--write-baseline"], capture_output=True, text=True, check=True)
+
+    # add a second, fresh violation not covered by the baseline
+    bad.write_text(bad.read_text() + textwrap.dedent("""
+        def save2(x, dist):
+            if jax.process_index() == 0:
+                dist.monitored_barrier()
+    """))
+    proc = subprocess.run([sys.executable, TOOL, str(bad), "--baseline", str(baseline),
+                           "--json"], capture_output=True, text=True)
+    assert proc.returncode == 1
+    rows = [json.loads(line) for line in proc.stdout.splitlines() if line.strip()]
+    assert len(rows) == 2
+    for row in rows:
+        assert set(row) == {"path", "check", "line", "message", "sanctioned"}
+        assert isinstance(row["line"], int) and row["line"] > 0
+        assert isinstance(row["sanctioned"], bool)
+        assert row["check"] == "divergent-collective"
+    assert sorted(r["sanctioned"] for r in rows) == [False, True]
+
+
+def test_stale_baseline_guard(tmp_path):
+    """--strict-baseline fails when the baseline holds entries no current
+    finding matches (the baseline shrank without being re-recorded)."""
+    bad = tmp_path / "mod.py"
+    _write_divergent_module(bad)
+    baseline = tmp_path / "baseline.txt"
+    subprocess.run([sys.executable, TOOL, str(bad), "--baseline", str(baseline),
+                    "--write-baseline"], capture_output=True, text=True, check=True)
+
+    # fix the violation: the baseline entry goes stale
+    bad.write_text("def save(x):\n    return x\n")
+    proc = subprocess.run([sys.executable, TOOL, str(bad), "--baseline", str(baseline)],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0  # lax mode tolerates stale entries
+    proc = subprocess.run([sys.executable, TOOL, str(bad), "--baseline", str(baseline),
+                           "--strict-baseline"], capture_output=True, text=True)
+    assert proc.returncode == 1 and "stale baseline entry" in proc.stdout
+
+
+def test_checks_flag_selects_family(tmp_path):
+    """--checks dist must not report jax-family findings and vice versa."""
+    bad = tmp_path / "mod.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        def _run_fused(self, t):
+            return float(t)
+
+        def save(x, dist):
+            if jax.process_index() == 0:
+                dist.barrier()
+    """))
+    # host-sync needs device taint; keep it simple: knob violation instead
+    bad.write_text(textwrap.dedent("""
+        import os, jax
+        def f():
+            return os.environ.get("DS_TPU_NOT_DECLARED")
+
+        def save(x, dist):
+            if jax.process_index() == 0:
+                dist.barrier()
+    """))
+    out_dist = subprocess.run([sys.executable, TOOL, str(bad), "--no-baseline",
+                               "--checks", "dist"], capture_output=True, text=True).stdout
+    out_jax = subprocess.run([sys.executable, TOOL, str(bad), "--no-baseline",
+                              "--checks", "jax"], capture_output=True, text=True).stdout
+    assert "[divergent-collective]" in out_dist and "[knob]" not in out_dist
+    assert "[knob]" in out_jax and "[divergent-collective]" not in out_jax
+
+
+@pytest.mark.fast
+def test_repo_clean_dist():
+    """The package must lint clean under BOTH families with a non-stale
+    baseline — the exact invocation CI runs (tools/lint_all.py)."""
+    proc = subprocess.run([sys.executable, str(ROOT / "tools" / "lint_all.py")],
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, f"lint_all found violations:\n{proc.stdout}{proc.stderr}"
+
+
+# ------------------------------------------------------- choreography auditor
+class TestCommAuditor:
+
+    def _audit_mod(self):
+        from deepspeed_tpu.analysis import comm_audit
+        return comm_audit
+
+    def test_ledger_records_in_order(self):
+        ca = self._audit_mod()
+        aud = ca.CommAuditor()
+        aud.record("all_reduce", "float32", (2, 4))
+        aud.record("barrier:save", "", ())
+        ops = aud.entries()
+        assert [o.op for o in ops] == ["all_reduce", "barrier:save"]
+        assert ops[0].shape == (2, 4) and ops[0].dtype == "float32"
+        aud.clear()
+        assert not aud.entries()
+
+    def test_ledger_bounded(self):
+        ca = self._audit_mod()
+        aud = ca.CommAuditor(max_entries=3)
+        for i in range(5):
+            aud.record("op", "f32", (i,))
+        assert len(aud.entries()) == 3 and aud.dropped == 2
+
+    def test_cross_check_identical_ledgers_pass(self):
+        ca = self._audit_mod()
+        led = [ca.CommOp("all_reduce", "float32", (4,)), ca.CommOp("barrier:x")]
+        assert ca.cross_check([led, list(led), list(led)]) is None
+
+    def test_cross_check_extra_op_reported_with_context(self):
+        ca = self._audit_mod()
+        common = [ca.CommOp("all_reduce", "float32", (4,))]
+        extra = common + [ca.CommOp("all_gather", "float32", (4,), axis="fsdp")]
+        report = ca.cross_check([common, extra])
+        assert report is not None
+        assert report.index == 1 and report.rank_b == 1
+        assert report.op_a is None and report.op_b.op == "all_gather"
+        assert report.context_a == tuple(common) and report.context_b == tuple(common)
+        text = report.render()
+        assert "rank 0: <end of ledger>" in text
+        assert "rank 1: all_gather(float32[4], axis=fsdp)" in text
+
+    def test_cross_check_shape_mismatch_reported(self):
+        ca = self._audit_mod()
+        report = ca.cross_check([[ca.CommOp("all_reduce", "float32", (4,))],
+                                 [ca.CommOp("all_reduce", "float32", (8,))]])
+        assert report is not None and report.index == 0
+        assert report.op_a.shape == (4,) and report.op_b.shape == (8,)
+
+    def test_knob_gates_auditor(self, monkeypatch):
+        ca = self._audit_mod()
+        try:
+            monkeypatch.delenv("DS_TPU_COMM_AUDIT", raising=False)
+            ca._reset_for_tests()
+            assert ca.get_auditor() is None
+            monkeypatch.setenv("DS_TPU_COMM_AUDIT", "1")
+            ca._reset_for_tests()
+            aud = ca.get_auditor()
+            assert aud is not None and ca.get_auditor() is aud
+        finally:
+            ca._reset_for_tests()
+
+    def test_error_carries_report_and_barrier(self):
+        ca = self._audit_mod()
+        report = ca.cross_check([[ca.CommOp("a")], [ca.CommOp("b")]])
+        err = ca.CommChoreographyError(report, barrier="save")
+        assert err.report is report
+        assert "barrier 'save'" in str(err) and "op index 0" in str(err)
+
+
+# ------------------------------------------------------ forked two-rank test
+@pytest.mark.dist
+def test_rank_conditional_collective_caught_at_barrier():
+    """An injected rank-conditional extra all_gather is converted by the
+    choreography auditor into a structured divergence report at the next
+    barrier — on every rank — instead of a hang."""
+    from dist_utils import run_distributed
+
+    body = """
+        import jax.numpy as jnp
+        import deepspeed_tpu.comm as dist
+
+        t = jnp.ones((2, 4), jnp.float32)
+
+        # choreographed phase: identical op sequence on every rank
+        dist.all_reduce(t)
+        dist.barrier()
+
+        # divergent phase: rank 1 issues one extra collective
+        dist.all_reduce(t)
+        if RANK == 1:
+            dist.all_gather_into_tensor(t)
+        try:
+            dist.barrier()
+            print("NO_DIVERGENCE")
+        except Exception as e:
+            assert type(e).__name__ == "CommChoreographyError", type(e)
+            msg = str(e)
+            assert "collective choreography divergence at op index 3" in msg, msg
+            assert "rank 0: <end of ledger>" in msg, msg
+            assert "rank 1: all_gather_into_tensor(float32[2x4])" in msg, msg
+            assert "rank 0 context:" in msg and "rank 1 context:" in msg, msg
+            print("CAUGHT_DIVERGENCE")
+    """
+    outs = run_distributed(body, n_procs=2, devices_per_proc=1,
+                           env={"DS_TPU_COMM_AUDIT": "1"})
+    assert all("CAUGHT_DIVERGENCE" in o for o in outs), outs
+    assert not any("NO_DIVERGENCE" in o for o in outs), outs

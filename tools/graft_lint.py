@@ -1,51 +1,84 @@
 #!/usr/bin/env python
-"""graft-lint CLI: run the JAX-hazard static checks over a source tree.
+"""graft-lint CLI: run the project's static check families over a source tree.
 
 Usage:
-    python tools/graft_lint.py deepspeed_tpu/
+    python tools/graft_lint.py deepspeed_tpu/                 # both families
+    python tools/graft_lint.py --checks jax deepspeed_tpu/    # PR-6 JAX hazards
+    python tools/graft_lint.py --checks dist deepspeed_tpu/   # mesh/SPMD/locks
+    python tools/graft_lint.py --json deepspeed_tpu/          # one finding per line
     python tools/graft_lint.py --write-baseline deepspeed_tpu/
 
 Exit code 0 when every finding is clean or baselined, 1 otherwise.
+``--strict-baseline`` additionally fails when the baseline holds entries
+no current finding matches (stale suppressions: the baseline shrank
+without being re-recorded) — only meaningful when linting the full
+default tree, so ``tools/lint_all.py`` passes it and ad-hoc subset runs
+don't.
 
-The checker (``deepspeed_tpu/analysis/static_checks.py``) is stdlib-only
-and is loaded straight from its file path so this tool never imports the
-package (and therefore never pays the jax import, and works in an
-environment without jax at all).
+The checkers (``deepspeed_tpu/analysis/static_checks.py`` and
+``deepspeed_tpu/analysis/dist_checks.py``) are stdlib-only and are loaded
+straight from their file paths so this tool never imports the package
+(and therefore never pays the jax import, and works in an environment
+without jax at all).
 """
 
 import argparse
 import importlib.util
+import json
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 CHECKS_PATH = os.path.join(REPO_ROOT, "deepspeed_tpu", "analysis", "static_checks.py")
+DIST_CHECKS_PATH = os.path.join(REPO_ROOT, "deepspeed_tpu", "analysis", "dist_checks.py")
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools", "graft_lint_baseline.txt")
 
 
-def _load_checks():
-    spec = importlib.util.spec_from_file_location("graft_lint_checks", CHECKS_PATH)
+def _load_module(name, path):
+    spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[spec.name] = mod  # dataclass machinery resolves the module by name
     spec.loader.exec_module(mod)
     return mod
 
 
+def _load_checks():
+    return _load_module("graft_lint_checks", CHECKS_PATH)
+
+
+def _load_dist_checks():
+    return _load_module("graft_lint_dist_checks", DIST_CHECKS_PATH)
+
+
 def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description="project-specific JAX-hazard linter")
+    ap = argparse.ArgumentParser(description="project-specific JAX/SPMD-hazard linter")
     ap.add_argument("paths", nargs="*", default=None,
                     help="files or directories to lint (default: deepspeed_tpu/)")
+    ap.add_argument("--checks", choices=("jax", "dist", "all"), default="all",
+                    help="check family: 'jax' (host-sync/jit-recompile/donated-reuse/"
+                         "knob), 'dist' (collective-axis/divergent-collective/"
+                         "lock-order), or 'all' (default)")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE,
                     help="suppression file (default: tools/graft_lint_baseline.txt)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="report every finding, ignoring the baseline")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline file from the current findings")
+    ap.add_argument("--strict-baseline", action="store_true",
+                    help="also fail on baseline entries matching no current finding")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit every finding as one JSON object per line "
+                         "(path, check, line, message, sanctioned)")
     args = ap.parse_args(argv)
 
     paths = args.paths or [os.path.join(REPO_ROOT, "deepspeed_tpu")]
     checks = _load_checks()
-    findings = checks.lint_paths(paths)
+    findings = []
+    if args.checks in ("jax", "all"):
+        findings.extend(checks.lint_paths(paths))
+    if args.checks in ("dist", "all"):
+        findings.extend(_load_dist_checks().lint_paths(paths))
+    findings.sort(key=lambda x: (x.path, x.line, x.check))
 
     sources = {}
     for f in {x.path for x in findings}:
@@ -67,21 +100,37 @@ def main(argv=None) -> int:
         with open(args.baseline, "w", encoding="utf-8") as f:
             f.write("# graft-lint baseline: findings accepted as-is, one per line as\n"
                     "#   relpath|check|stripped source line\n"
+                    "# Committed empty (headers only) = the repo lints clean.\n"
                     "# Regenerate with: python tools/graft_lint.py --write-baseline\n")
             for key in sorted({k for _, k in keyed}):
                 f.write("|".join(key) + "\n")
         print(f"wrote {len({k for _, k in keyed})} baseline entries to {args.baseline}")
         return 0
 
-    baseline = set() if args.no_baseline else checks.load_baseline(args.baseline)
-    fresh = [fi for fi, key in keyed if key not in baseline]
+    baseline = checks.load_baseline(args.baseline)
+    active = set() if args.no_baseline else baseline
+    fresh = [fi for fi, key in keyed if key not in active]
     suppressed = len(findings) - len(fresh)
+    stale = sorted(baseline - {k for _, k in keyed}) if args.strict_baseline else []
+
+    if args.as_json:
+        fresh_ids = {id(fi) for fi in fresh}
+        for fi, _key in keyed:
+            print(json.dumps({
+                "path": rel(fi.path), "check": fi.check, "line": fi.line,
+                "message": fi.message, "sanctioned": id(fi) not in fresh_ids,
+            }, sort_keys=True))
+        return 1 if fresh or stale else 0
 
     for fi in fresh:
         print(f"{rel(fi.path)}:{fi.line}: [{fi.check}] {fi.message}")
+    for key in stale:
+        print(f"stale baseline entry (no current finding matches): {'|'.join(key)}")
     tail = f" ({suppressed} baselined)" if suppressed else ""
-    print(f"graft-lint: {len(fresh)} finding(s){tail} over {len(paths)} path(s)")
-    return 1 if fresh else 0
+    if stale:
+        tail += f", {len(stale)} stale baseline entr{'y' if len(stale) == 1 else 'ies'}"
+    print(f"graft-lint[{args.checks}]: {len(fresh)} finding(s){tail} over {len(paths)} path(s)")
+    return 1 if fresh or stale else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Single CI entry point: every graft-lint check family over the package.
+
+Equivalent to ``python tools/graft_lint.py --checks all --strict-baseline``
+with the default tree. Runs the PR-6 JAX-hazard checks (host-sync,
+jit-recompile, donated-reuse, knob) and the dist checks (collective-axis,
+divergent-collective, lock-order) in one pass, and fails on stale
+baseline entries so the suppression file can never drift from reality.
+
+Exit code 0 = the repo is clean.
+"""
+
+import importlib.util
+import os
+import sys
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location(
+        "graft_lint_cli", os.path.join(_TOOLS_DIR, "graft_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def main(argv=None) -> int:
+    extra = list(argv) if argv is not None else sys.argv[1:]
+    return _load_cli().main(["--checks", "all", "--strict-baseline"] + extra)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
