@@ -49,6 +49,49 @@ import time
 # filled by run_serve/run_serve_prefix, exported by _dump_telemetry
 _EVENT_LATENCY = {}
 
+# performance-accounting snapshot per serve rung ({rung: PerfAccountant
+# .snapshot()}); filled by the serve rungs, exported by _dump_perf as
+# BENCH_PERF.json — the input of tools/perf_report.py
+_PERF_EXTRA = {}
+
+
+def _perf_begin():
+    """Arm the accountant for a timed window: zero the attribution
+    counters but KEEP the cost cards built during warmup, so the timed
+    run stays compile- and trace-free (mode 2's AOT analysis happened at
+    warmup)."""
+    from deepspeed_tpu.telemetry import get_perf_accountant
+
+    acct = get_perf_accountant()
+    if acct.enabled:
+        acct.reset_counts()
+    return acct
+
+
+def _perf_extras(rung, acct, dt):
+    """Result-dict extras for one timed serve window: model FLOPs, MFU
+    over the measured wall window (honest under deferred dispatch, where
+    per-card device time is only a dispatch-side lower bound), goodput
+    fraction (useful tokens / padded slot tokens), per-pool HBM bytes.
+    Extras ride the result dict; contracts and frozen hashes untouched."""
+    if not acct.enabled:
+        return {}
+    tot = acct.totals()
+    mfu = acct.mfu(flops=tot["flops"], time_s=dt)
+    hbm = acct.hbm()
+    _PERF_EXTRA[rung] = acct.snapshot()
+    return {
+        "model_flops": int(tot["flops"]),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "goodput": round(tot["useful_tokens"] / tot["slot_tokens"], 4)
+        if tot["slot_tokens"] else 0.0,
+        "hbm": {"weights": int(hbm.get("weights", 0)),
+                "kv_pages": int(hbm.get("kv_pages", 0)),
+                "prefix": int(hbm.get("prefix", 0)),
+                "temp_peak": int(hbm.get("temp_peak", 0)),
+                "pressure": round(float(hbm.get("pressure", 0.0)), 4)},
+    }
+
 # ---------------------------------------------------------------------------
 # FROZEN BENCH CONTRACT (BASELINE.md "Frozen rung contract")
 #
@@ -330,6 +373,7 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
     lens = rng.randint(max(4, prompt_len // 2), prompt_len + 1, size=n_prompts)
     prompts = [rng.randint(0, cfg_model.vocab_size, size=(int(l),)).tolist() for l in lens]
     eng.generate(prompts, max_new_tokens=new_tokens)  # compile every bucket/burst shape
+    acct = _perf_begin()
     from deepspeed_tpu.telemetry import get_event_log, get_registry, latency_summary
     reg = get_registry()
     disp = reg.counter("infer_dispatches_total")
@@ -359,7 +403,8 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
                          "cached_token_fraction": round((hit_toks.value - ht0) / max(1, prompt_toks), 4),
                          "ttft_p50_s": lat["ttft_p50_s"], "ttft_p99_s": lat["ttft_p99_s"],
                          "tpot_p50_s": lat["tpot_p50_s"], "tpot_p99_s": lat["tpot_p99_s"],
-                         "queue_time_fraction": lat["queue_time_fraction"]}
+                         "queue_time_fraction": lat["queue_time_fraction"],
+                         **_perf_extras("serve", acct, dt)}
 
 
 def run_serve_prefix(jax, jnp, np, cfg_model, platform):
@@ -396,6 +441,7 @@ def run_serve_prefix(jax, jnp, np, cfg_model, platform):
         return [shared + rng.randint(0, cfg_model.vocab_size, size=int(l)).tolist() for l in lens]
 
     eng.generate(wave(), max_new_tokens=new_toks)  # cold: compiles + populates the tree
+    acct = _perf_begin()
     reg = get_registry()
     hits = reg.counter("kv_prefix_hits_total")
     hit_toks = reg.counter("kv_prefix_hit_tokens_total")
@@ -424,6 +470,7 @@ def run_serve_prefix(jax, jnp, np, cfg_model, platform):
         "ttft_p50_s": lat["ttft_p50_s"], "ttft_p99_s": lat["ttft_p99_s"],
         "tpot_p50_s": lat["tpot_p50_s"], "tpot_p99_s": lat["tpot_p99_s"],
         "queue_time_fraction": lat["queue_time_fraction"],
+        **_perf_extras("serve_prefix", acct, dt),
     }
 
 
@@ -474,6 +521,7 @@ def run_serve_spec(jax, jnp, np, cfg_model, platform):
             state_manager=smc, dtype=dtype, decode_burst=0,
             spec_decode=spec_on, spec_k=spec_k))
         eng.generate(prompts, max_new_tokens=new_toks)  # compile all verify/decode shapes
+        acct = _perf_begin()
         t0_tok, t0_steps = c_dec_tok.value, c_dec_steps.value
         p0, a0 = c_prop.value, c_acc.value
         from deepspeed_tpu.telemetry import get_event_log, latency_summary
@@ -491,6 +539,8 @@ def run_serve_spec(jax, jnp, np, cfg_model, platform):
             "tokens_per_decode_dispatch": dec_tok / dec_steps / n_req,
             "decode_dispatches": int(dec_steps),
             "proposed": c_prop.value - p0, "accepted": c_acc.value - a0,
+            # spec-off writes first, spec-on (the headline run) overwrites
+            "perf": _perf_extras("serve_spec", acct, dt),
         }
 
     off = run(False)
@@ -512,6 +562,7 @@ def run_serve_spec(jax, jnp, np, cfg_model, platform):
         "tokens_per_sec_off": round(off["tps"], 1),
         "greedy_parity": True,
         "ttft_p50_s": on["lat"]["ttft_p50_s"], "tpot_p50_s": on["lat"]["tpot_p50_s"],
+        **on["perf"],
     }
 
 
@@ -765,6 +816,10 @@ def main():
     if rung not in known:
         print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected {' | '.join(known)}", file=sys.stderr)
         return 1
+    # bench opts into mode 2 (AOT XLA cost/memory analysis): the extra
+    # compile per program signature lands in warmup, outside every timed
+    # window; an explicit DS_TPU_PERF_ACCOUNT in the env still wins
+    os.environ.setdefault("DS_TPU_PERF_ACCOUNT", "2")
     n_dev, platform = _probe_backend()
 
     import jax
@@ -830,6 +885,7 @@ def main():
             flush_extra()
         print(f"[bench] wrote {path}", file=sys.stderr)
     _dump_telemetry(rung)
+    _dump_perf(rung)
     return 0
 
 
@@ -852,6 +908,28 @@ def _dump_telemetry(rung):
         print(f"[bench] wrote {path}", file=sys.stderr)
     except Exception as e:
         print(f"[bench] telemetry dump failed: {type(e).__name__}: {e}", file=sys.stderr)
+
+
+def _dump_perf(rung):
+    """Per-rung performance-accounting snapshots (cost cards, roofline
+    inputs, goodput ledger, HBM pools) -> BENCH_PERF.json, the artifact
+    ``tools/perf_report.py`` renders."""
+    try:
+        from deepspeed_tpu.telemetry import get_perf_accountant
+
+        acct = get_perf_accountant()
+        snaps = dict(_PERF_EXTRA)
+        if not snaps:
+            if not acct.enabled:
+                return
+            snaps = {rung: acct.snapshot()}
+        doc = {"rung": rung, "snapshots": snaps}
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "BENCH_PERF.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        print(f"[bench] wrote {path}", file=sys.stderr)
+    except Exception as e:
+        print(f"[bench] perf dump failed: {type(e).__name__}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -21,6 +21,9 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         self._name = "tpu"
         self._communication_backend_name = "xla"  # ICI within slice, DCN across
         self._seed: Optional[int] = None
+        # XLA's peak_bytes_in_use is monotonic per process; emulate the
+        # torch reset semantics with a per-device baseline offset
+        self._peak_baseline: dict = {}
 
     # --- identity ---
     def is_synchronized_device(self) -> bool:
@@ -80,10 +83,19 @@ class TPU_Accelerator(DeepSpeedAccelerator):
         return int(self._stats(device_index).get("bytes_in_use", 0))
 
     def max_memory_allocated(self, device_index=None) -> int:
-        return int(self._stats(device_index).get("peak_bytes_in_use", 0))
+        """Peak bytes in use since the last ``reset_peak_memory_stats``
+        (torch semantics). XLA's counter never resets, so the peak is
+        reported relative to the baseline captured at reset time."""
+        peak = int(self._stats(device_index).get("peak_bytes_in_use", 0))
+        return max(0, peak - self._peak_baseline.get(device_index or 0, 0))
 
     def reset_peak_memory_stats(self, device_index=None):
-        pass  # XLA exposes no reset; peak is monotonic per process
+        # XLA exposes no reset; rebase instead. The new baseline is the
+        # monotonic process peak (current live bytes can only be lower),
+        # so the next max_memory_allocated reports peak-since-reset.
+        s = self._stats(device_index)
+        self._peak_baseline[device_index or 0] = max(
+            int(s.get("peak_bytes_in_use", 0)), int(s.get("bytes_in_use", 0)))
 
     def total_memory(self, device_index=None) -> int:
         return int(self._stats(device_index).get("bytes_limit", 0))

@@ -23,11 +23,23 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 
+def leaf_signature(x: Any) -> Any:
+    """Abstract signature of a pytree of call arguments — shapes/dtypes
+    for arrays, types for python scalars — the same thing jit keys its
+    trace cache on. Shared with ``telemetry/costs.py``, whose cost cards
+    are bucketed per (program, signature), i.e. per XLA executable."""
+    return _leaf_signature(x)
+
+
 def _leaf_signature(x: Any) -> Any:
     shape = getattr(x, "shape", None)
     dtype = getattr(x, "dtype", None)
     if shape is not None and dtype is not None:
-        return ("arr", tuple(shape), str(dtype))
+        # keep the np.dtype object: it hashes/compares in ~0.1us (and
+        # compares == to its name string) where str(dtype) costs ~7us —
+        # this is the per-dispatch hot path of the auditor and the
+        # performance accountant
+        return ("arr", tuple(shape), dtype)
     if isinstance(x, (int, float, bool, complex)) or x is None:
         # python scalars are traced as weak-typed values: the VALUE does not
         # retrace, only the type does

@@ -193,6 +193,20 @@ declare("DS_TPU_STALL_S", "30", "float",
         "Queue-stall detector threshold: alert when the oldest queued request "
         "waits longer than this many seconds.",
         "telemetry/health.py")
+declare("DS_TPU_PERF_ACCOUNT", "1", "int",
+        "Serving performance accounting: 0 off, 1 analytic cost cards "
+        "(jaxpr FLOP walk, compile-free), 2 adds AOT XLA cost/memory "
+        "analysis per program signature (one extra compile at warmup).",
+        "telemetry/costs.py")
+declare("DS_TPU_PEAK_TFLOPS", "0", "float",
+        "Declared peak dense TFLOP/s per chip for MFU and roofline "
+        "readouts (0 = auto-detect from the device kind; unknown kinds "
+        "report no MFU).",
+        "telemetry/costs.py")
+declare("DS_TPU_PEAK_GBPS", "0", "float",
+        "Declared peak HBM GB/s per chip for roofline classification "
+        "(0 = auto-detect from the device kind).",
+        "telemetry/costs.py")
 
 # Ops / kernels
 declare("DS_TPU_OP_", None, "str",

@@ -28,9 +28,10 @@ from ...analysis.transfer_guard import maybe_guard
 from ...models.transformer import TransformerConfig
 from ...telemetry import get_registry as get_telemetry_registry
 from ...telemetry import span as telemetry_span
+from ...telemetry.costs import get_perf_accountant
 from ...telemetry.events import get_event_log
-from ...telemetry.health import (QueueStallDetector, SLOBurnRateDetector,
-                                 get_health_monitor)
+from ...telemetry.health import (HBMPressureDetector, QueueStallDetector,
+                                 SLOBurnRateDetector, get_health_monitor)
 from ...utils.logging import log_dist, logger
 from .model_runner import (make_burst_fn, make_fused_step_fn, make_spec_verify_fn,
                            make_step_fns)
@@ -169,6 +170,12 @@ class InferenceEngineV2:
         self._health = get_health_monitor()
         self._health.ensure_detector(QueueStallDetector())
         self._health.ensure_detector(SLOBurnRateDetector())
+        self._health.ensure_detector(HBMPressureDetector())
+        # performance accounting (docs/OBSERVABILITY.md "Performance
+        # accounting"): cost cards per compiled program, goodput ledger,
+        # per-pool HBM gauges feeding the pressure detector
+        self._acct = get_perf_accountant()
+        self._m_cow_bytes = tele.counter("kv_cow_bytes_total")
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -179,6 +186,9 @@ class InferenceEngineV2:
         self.k_pages = jnp.zeros((L, n_blocks, bs, cfg.kv_heads, cfg.head_dim), self.dtype)
         self.v_pages = jnp.zeros_like(self.k_pages)
         self._max_blocks_per_seq = -(-smc.max_context // bs)
+        # K+V bytes one block holds across every layer — the unit of COW
+        # copy traffic and of prefix-cache-held HBM
+        self._block_bytes = (self.k_pages.nbytes + self.v_pages.nbytes) // n_blocks
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.tree_util.tree_map(cast, params)
@@ -207,6 +217,11 @@ class InferenceEngineV2:
         run_mesh = self._mesh_topo.mesh if self._mesh_topo is not None else None
         self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp)
         self._run_cfg, self._interpret, self._run_mesh = run_cfg, interpret, run_mesh
+        # the accountant wraps the RAW jitted programs (innermost), so cost
+        # cards trace/AOT-analyze the real executable; the JitAuditor wraps
+        # outside and its recompile semantics are untouched
+        self._prefill_fn = self._acct.wrap("prefill", self._prefill_fn)
+        self._decode_fn = self._acct.wrap("decode", self._decode_fn)
         # runtime sanitizers (analysis/, all off by default): recompile audit
         # wraps every jitted serving program; the transfer guard scopes the
         # serving loops so implicit device->host syncs raise
@@ -239,6 +254,7 @@ class InferenceEngineV2:
         self._spec_accepted_run = 0
         self._sampling = None  # (do_sample, temperature, top_k, top_p) during generate()
         self._rng = jax.random.PRNGKey(0)
+        self._update_hbm_gauges()
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
                  f"({n_blocks * bs} cached tokens), dtype={config.dtype}", ranks=[0])
 
@@ -260,6 +276,7 @@ class InferenceEngineV2:
             do, t, k, p = key
             fn = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
                                tp=self._tp, do_sample=do, temperature=t, top_k=k, top_p=p)
+            fn = self._acct.wrap(f"burst{key}", fn)
             if self.jit_auditor is not None:
                 fn = self.jit_auditor.wrap(f"burst{key}", fn)
             self._bursts[key] = fn
@@ -391,9 +408,15 @@ class InferenceEngineV2:
             self._cow_fn = jax.jit(
                 lambda kp, vp, s, d: (kp.at[:, d].set(kp[:, s]), vp.at[:, d].set(vp[:, s])),
                 donate_argnums=(0, 1))
+            # timed=False: COW dispatches inside another quantum's window,
+            # so it must not steal that quantum's time attribution — its
+            # cost is accounted in bytes, not seconds
+            self._cow_fn = self._acct.wrap("cow_copy", self._cow_fn, timed=False)
             if self.jit_auditor is not None:
                 self._cow_fn = self.jit_auditor.wrap("cow_copy", self._cow_fn)
         self.k_pages, self.v_pages = self._cow_fn(self.k_pages, self.v_pages, src, dst)
+        self._m_cow_bytes.inc(self._block_bytes)
+        self._acct.note_cow(self._block_bytes)
 
     def _cow_ready(self, seq, start_pos: int) -> None:
         self.state.ensure_writable(seq, start_pos, self._copy_block)
@@ -461,12 +484,18 @@ class InferenceEngineV2:
         self._m_prefill_fill.set(n / B)
         for seq in seqs:
             seq.post_forward()
+        useful = sum(len(t) for t in token_lists)
         if defer:
-            return self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
+            out_dev = self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
+            self._acct.attribute(useful, B * S)
+            return out_dev
         if return_tokens:
             out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         else:
             out = jax.device_get(logits[:n])  # graft-lint: readback (caller asked for host logits)
+        # attribution window closes AFTER the readback: in synchronous
+        # paths the wall time covers the device execution
+        self._acct.attribute(useful, B * S)
         return [out[j] for j in range(n)]
 
     def _decode_bucket(self, n: int) -> int:
@@ -539,10 +568,15 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
         if defer:
-            return self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
+            out_dev = self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
+            self._acct.attribute(n, len(ctx))
+            return out_dev
         if return_tokens:
-            return self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
-        return jax.device_get(logits[:n])  # graft-lint: readback (caller asked for host logits)
+            out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
+        else:
+            out = jax.device_get(logits[:n])  # graft-lint: readback (caller asked for host logits)
+        self._acct.attribute(n, len(ctx))
+        return out
 
     def _burst_steps(self, live: Dict[int, int], remaining: int) -> int:
         """Largest power-of-two burst length every live sequence can take.
@@ -588,8 +622,11 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
         if defer:
+            self._acct.attribute(n * steps, len(ctx) * steps)
             return toks[:n]  # device (n, steps), no readback
-        return jax.device_get(toks[:n])  # graft-lint: readback (n*steps ints, the burst's one fetch)
+        out = jax.device_get(toks[:n])  # graft-lint: readback (n*steps ints, the burst's one fetch)
+        self._acct.attribute(n * steps, len(ctx) * steps)
+        return out
 
     # ---------------------------------------------------------- fused quantum
     def _fused_bucket(self, n_dec: int, n_pre: int, max_chunk: int) -> Tuple[int, int, int]:
@@ -628,6 +665,7 @@ class InferenceEngineV2:
                                     mesh=self._run_mesh, tp=self._tp,
                                     n_dec=n_dec, n_pre=n_pre, chunk=chunk,
                                     do_sample=do, temperature=t, top_k=k, top_p=p)
+            fn = self._acct.wrap(f"fused{key}", fn)
             if self.jit_auditor is not None:
                 fn = self.jit_auditor.wrap(f"fused{key}", fn)
             self._fused_fns[key] = fn
@@ -771,6 +809,7 @@ class InferenceEngineV2:
         # non-deferred mode fetches the quantum's sampled tokens in ONE
         # readback (N*steps ints) instead of one tiny transfer per row
         toks_host = None if defer else jax.device_get(toks)  # graft-lint: readback
+        self._acct.attribute(real, D * steps + P * S)
         out: Dict[int, object] = {}
         for j, uid in enumerate(dec_uids):
             out[uid] = toks[j] if defer else toks_host[j]
@@ -797,6 +836,7 @@ class InferenceEngineV2:
             fn = make_spec_verify_fn(self._run_cfg, interpret=self._interpret,
                                      mesh=self._run_mesh, tp=self._tp, chunk=chunk,
                                      do_sample=do, temperature=t, top_k=k, top_p=p)
+            fn = self._acct.wrap(f"spec{key}", fn)
             if self.jit_auditor is not None:
                 fn = self.jit_auditor.wrap(f"spec{key}", fn)
             self._spec_fns[key] = fn
@@ -900,6 +940,10 @@ class InferenceEngineV2:
             if ev:
                 self._events.emit("decode", uid, q=q, k=n_commit, accepted=acc)
         total_prop = int(n_draft[:n].sum())
+        # useful = committed tokens (carry + accepted drafts); slots = the
+        # whole padded verify window the program actually computed
+        self._acct.attribute(n + total_acc, B * chunk)
+        self._acct.note_spec(total_prop, total_acc)
         self._m_decode_tokens.inc(n + total_acc)
         self._m_spec_proposed.inc(total_prop)
         self._m_spec_accepted.inc(total_acc)
@@ -942,6 +986,29 @@ class InferenceEngineV2:
                 return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
         finally:
             self._sampling = None
+            self._update_hbm_gauges()
+
+    def _update_hbm_gauges(self) -> None:
+        """Refresh the per-pool HBM gauges (weights, paged KV, prefix-held
+        blocks, compiled-program temp peak) and feed the pressure detector.
+        Pure host arithmetic over already-known sizes — no device sync."""
+        if not self._acct.enabled:
+            return
+        weights = sum(int(getattr(x, "nbytes", 0))
+                      for x in jax.tree_util.tree_leaves(self.params))
+        pages = int(self.k_pages.nbytes) + int(self.v_pages.nbytes)
+        pc = self.state.prefix_cache
+        prefix = pc.cached_blocks * self._block_bytes if pc is not None else 0
+        limit = 0
+        try:
+            stats = jax.devices()[0].memory_stats() or {}
+            limit = int(stats.get("bytes_limit", 0))
+        except Exception:
+            pass  # CPU/interpret backends expose no memory stats
+        pressure = self._acct.set_hbm(limit=limit, weights=weights,
+                                      kv_pages=pages, prefix=prefix)
+        self._health.observe_hbm(pressure, weights_bytes=weights,
+                                 kv_pages_bytes=pages)
 
     def _commit_closures(self, reqs, results, pieces, counts, decode_ready, eos_token_id, on_token):
         """(commit, commit_dev) shared by the fused and unfused loops."""

@@ -279,6 +279,17 @@ def spec_verify_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.nda
     return mods.unembed(cfg, params, x_all, zeros), k_pages, v_pages
 
 
+def _stamp_cost_meta(fn, **meta):
+    """Attach program-class metadata for the performance accountant's
+    cost cards (telemetry/costs.py): the roofline report labels each
+    bucket with its kind + static shape instead of a bare signature."""
+    try:
+        fn._cost_meta = meta
+    except Exception:
+        pass  # a backend whose jit wrapper rejects attributes loses labels only
+    return fn
+
+
 def make_spec_verify_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1, *,
                         chunk: int, do_sample: bool = False, temperature: float = 1.0,
                         top_k: int = 0, top_p: float = 1.0):
@@ -310,7 +321,8 @@ def make_spec_verify_fn(cfg: TransformerConfig, interpret: bool = False, mesh=No
                                                temperature=temperature, top_k=top_k, top_p=top_p)
         return committed, accepted.astype(jnp.int32), k_pages, v_pages
 
-    return jax.jit(verify, donate_argnums=(3, 4))
+    return _stamp_cost_meta(jax.jit(verify, donate_argnums=(3, 4)),
+                            kind="spec_verify", chunk=chunk, sampled=do_sample)
 
 
 def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
@@ -319,7 +331,8 @@ def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
                       donate_argnums=(3, 4), static_argnames=())
     decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp),
                      donate_argnums=(3, 4), static_argnames=())
-    return prefill, decode
+    return (_stamp_cost_meta(prefill, kind="prefill"),
+            _stamp_cost_meta(decode, kind="decode"))
 
 
 def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1,
@@ -358,7 +371,8 @@ def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
         (_, k_pages, v_pages, _, _), toks = jax.lax.scan(step, carry0, slots)
         return toks.T, k_pages, v_pages
 
-    return jax.jit(burst, donate_argnums=(3, 4))
+    return _stamp_cost_meta(jax.jit(burst, donate_argnums=(3, 4)),
+                            kind="decode_burst", sampled=do_sample)
 
 
 def make_fused_step_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1, *,
@@ -422,4 +436,6 @@ def make_fused_step_fn(cfg: TransformerConfig, interpret: bool = False, mesh=Non
         toks = jnp.concatenate([tok0[:, None], rest.T], axis=1)  # (N, steps)
         return toks, k_pages, v_pages
 
-    return jax.jit(fused, donate_argnums=(3, 4))
+    return _stamp_cost_meta(jax.jit(fused, donate_argnums=(3, 4)),
+                            kind="fused_step", n_dec=n_dec, n_pre=n_pre,
+                            chunk=chunk, sampled=do_sample)

@@ -22,6 +22,7 @@ import numpy as np
 from ....analysis import knobs
 from ....telemetry import get_registry as get_telemetry_registry
 from ....telemetry import span as telemetry_span
+from ....telemetry.costs import get_perf_accountant
 from ....telemetry.events import get_event_log
 from ....utils.logging import logger
 from .blocked_allocator import BlockedAllocator
@@ -155,6 +156,9 @@ class DSStateManager:
             seq.shared_blocks = len(blocks)
             seq.seen_tokens = matched
             seq.token_log = [int(t) for t in tokens[:matched]]
+            # goodput ledger: these tokens never re-run prefill — the
+            # accountant prices the saved FLOPs at the prefill-card rate
+            get_perf_accountant().note_prefix_hit(matched)
             self._sync_gauges()
         self._events.emit("admit", uid, hit=seq.seen_tokens,
                           prompt=len(tokens))

@@ -86,6 +86,10 @@ class RaggedBatchScheduler:
         self._m_decodes = tele.counter("sched_decodes_total")
         self._m_prefill_chunks = tele.counter("sched_prefill_chunks_total")
         self._m_quantum_rows = tele.gauge("sched_quantum_rows")
+        # real (unpadded) tokens scheduled across all quanta — the
+        # numerator of the scheduler-level goodput view (the engine's
+        # dispatch buckets add pow2 padding on top of this)
+        self._m_useful = tele.counter("sched_useful_tokens_total")
         self._events = get_event_log()
         self._quantum_seq = 0  # monotone id shared by fused and unfused paths
 
@@ -163,6 +167,7 @@ class RaggedBatchScheduler:
         self._m_step_tokens.set(self.max_batch_tokens - budget)
         self._m_decodes.inc(len(sched_decodes))
         self._m_prefill_chunks.inc(len(prefills))
+        self._m_useful.inc(self.max_batch_tokens - budget)
         if prefills or sched_decodes:
             self._events.emit("quantum", q=q, prefills=len(prefills),
                               decodes=len(sched_decodes),
@@ -199,6 +204,7 @@ class RaggedBatchScheduler:
         self._m_decodes.inc(len(admitted))
         self._m_step_tokens.set(len(admitted) * tokens_per_row)
         self._m_quantum_rows.set(len(admitted))
+        self._m_useful.inc(len(admitted) * tokens_per_row)
         if admitted:
             self._events.emit("quantum", q=q, prefills=0, decodes=len(admitted),
                               tokens=len(admitted) * tokens_per_row, spec_k=tokens_per_row - 1)

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ...telemetry import get_registry as get_telemetry_registry
+from ...telemetry.costs import get_perf_accountant
 from ...telemetry.events import get_event_log
 from ...telemetry.health import (QueueStallDetector, SLOBurnRateDetector,
                                  get_health_monitor)
@@ -274,10 +275,26 @@ def sweep(engine, rates: Sequence[float], base: Optional[LoadSpec] = None,
     rate (the FastGen blog's table shape). The engine's KV pool is reused
     across rows; each row waits for full drain, so rows are independent."""
     base = base or LoadSpec()
+    acct = get_perf_accountant()
     rows = []
     for rate in rates:
         spec = dataclasses.replace(base, arrival_rate=float(rate))
+        before = acct.totals() if acct.enabled else None
+        t0 = time.perf_counter()
         row = summarize(run_load(engine, spec), ttft_sla=ttft_sla, tpot_sla=tpot_sla)
+        if before is not None:
+            # performance-accounting columns: attributed model FLOPs over
+            # the row's wall window (docs/OBSERVABILITY.md "Performance
+            # accounting") — the throughput-latency table gains an MFU axis
+            dt = time.perf_counter() - t0
+            after = acct.totals()
+            flops = after["flops"] - before["flops"]
+            useful = after["useful_tokens"] - before["useful_tokens"]
+            slot = after["slot_tokens"] - before["slot_tokens"]
+            mfu = acct.mfu(flops=flops, time_s=dt)
+            row["model_flops"] = int(flops)
+            row["mfu"] = round(mfu, 4) if mfu is not None else None
+            row["goodput_fraction"] = round(useful / slot, 4) if slot else 0.0
         row["arrival_rate"] = float(rate)
         rows.append(row)
     return rows

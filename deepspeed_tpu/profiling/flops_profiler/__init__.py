@@ -1,7 +1,7 @@
-from .profiler import (FlopsProfiler, get_model_profile, flops_of_fn, flops_of_jaxpr, flops_to_string,
+from .profiler import (FlopsProfiler, breakdown_of_fn, get_model_profile, flops_of_fn, flops_of_jaxpr, flops_to_string,
                        macs_to_string, params_to_string, number_to_string, duration_to_string)
 
 __all__ = [
-    "FlopsProfiler", "get_model_profile", "flops_of_fn", "flops_of_jaxpr", "flops_to_string", "macs_to_string",
+    "FlopsProfiler", "breakdown_of_fn", "get_model_profile", "flops_of_fn", "flops_of_jaxpr", "flops_to_string", "macs_to_string",
     "params_to_string", "number_to_string", "duration_to_string"
 ]

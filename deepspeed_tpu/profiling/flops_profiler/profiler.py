@@ -131,6 +131,18 @@ def flops_of_fn(fn: Callable, *args, **kwargs) -> Tuple[int, int]:
     return flops_of_jaxpr(jaxpr)
 
 
+def breakdown_of_fn(fn: Callable, *args, **kwargs) -> Tuple[int, int, Dict[str, int]]:
+    """(flops, macs, per-primitive flop breakdown) of ``fn`` on these args.
+
+    The breakdown attributes whole control-flow regions (scan/while/cond)
+    to their head primitive and descends through transparent call wrappers
+    (pjit/remat). Shared with the serving cost-card builder
+    (``telemetry/costs.py``) and the golden-count tests."""
+    jaxpr = jax.make_jaxpr(lambda *a: fn(*a, **kwargs))(*args)
+    f, m = flops_of_jaxpr(jaxpr)
+    return f, m, FlopsProfiler._primitive_breakdown(jaxpr)
+
+
 # -------------------- string formatting (reference profiler.py:905-960) ----
 def number_to_string(num, units=None, precision=2) -> str:
     if units is None:

@@ -263,10 +263,17 @@ class DeepSpeedEngine:
         self._m_loss = tele.gauge("train_loss")
         self._m_gnorm = tele.gauge("train_grad_norm")
         self._m_tps = tele.gauge("train_tokens_per_sec")
+        self._m_mfu = tele.gauge("train_mfu")
         self._m_heartbeat = tele.gauge("last_step_completed_unix")
         self._m_grad_sync_bytes = tele.counter("comm_bytes_total", op="grad_sync_estimated")
         self._last_microbatch_tokens = 0
         self._last_step_pc = None
+        # analytic fwd+bwd FLOPs for the MFU gauge: traced once per batch
+        # shape (keyed on token count) via the same jaxpr walk the serving
+        # cost cards use; 0 means unavailable/disabled and the gauge stays 0
+        self._step_flops = 0
+        self._step_flops_tokens = -1
+        self._peak_flops: Optional[float] = None
         self._monitor_bridge = MonitorBridge(
             tele, self.monitor,
             every_n_steps=knobs.get_int("DS_TPU_TELEMETRY_FLUSH_STEPS"))
@@ -601,6 +608,16 @@ class DeepSpeedEngine:
             self._last_microbatch_tokens = _batch_tokens(batch)
             batch = self._put_batch(batch)
             scale = self.loss_scaler.loss_scale / self.gradient_accumulation_steps
+            if (self._step_flops_tokens != self._last_microbatch_tokens
+                    and knobs.get_int("DS_TPU_PERF_ACCOUNT")):
+                self._step_flops_tokens = self._last_microbatch_tokens
+                try:
+                    from ..profiling.flops_profiler import flops_of_fn
+                    self._step_flops, _ = flops_of_fn(
+                        lambda p, b, st, s: self._fwd_bwd(p, b, st, s),
+                        self.params, batch, self.micro_steps, scale)
+                except Exception:
+                    self._step_flops = 0  # MFU gauge stays dark; never block training
             profiling = (self.config.flops_profiler.enabled
                          and self.global_steps == self.config.flops_profiler.profile_step
                          and (self.micro_steps - self._accum_base) % self.gradient_accumulation_steps == 0)  # first micro-batch only
@@ -734,6 +751,13 @@ class DeepSpeedEngine:
             # deep enough that dispatch tracks execution
             self._m_tps.set(self._last_microbatch_tokens * self.gradient_accumulation_steps
                             / (now_pc - self._last_step_pc))
+            if self._step_flops:
+                if self._peak_flops is None:
+                    from ..telemetry.costs import resolve_peaks
+                    self._peak_flops = resolve_peaks()[0]
+                if self._peak_flops > 0:
+                    self._m_mfu.set(self._step_flops * self.gradient_accumulation_steps
+                                    / (now_pc - self._last_step_pc) / self._peak_flops)
         self._last_step_pc = now_pc
         if self.global_steps % self.config.steps_per_print == 0:
             self._report(lr)

@@ -15,10 +15,11 @@ from .events import (EventLog, get_event_log, latency_summary,
                      lifecycle_signature, request_metrics,
                      request_timelines, validate_timeline)
 from .health import (Alert, CallbackAlertSink, Detector,
-                     GradNormSpikeDetector, HealthMonitor, JsonlAlertSink,
-                     LoggerAlertSink, NonFiniteLossDetector,
-                     QueueStallDetector, SLOBurnRateDetector,
-                     get_health_monitor)
+                     GradNormSpikeDetector, HBMPressureDetector,
+                     HealthMonitor, JsonlAlertSink, LoggerAlertSink,
+                     NonFiniteLossDetector, QueueStallDetector,
+                     SLOBurnRateDetector, get_health_monitor)
+from .costs import CostCard, PerfAccountant, get_perf_accountant, resolve_peaks
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
@@ -28,8 +29,9 @@ __all__ = [
     "latency_summary", "lifecycle_signature", "validate_timeline",
     "Alert", "Detector", "HealthMonitor", "get_health_monitor",
     "NonFiniteLossDetector", "GradNormSpikeDetector", "QueueStallDetector",
-    "SLOBurnRateDetector", "LoggerAlertSink", "JsonlAlertSink",
-    "CallbackAlertSink",
+    "SLOBurnRateDetector", "HBMPressureDetector", "LoggerAlertSink",
+    "JsonlAlertSink", "CallbackAlertSink",
+    "CostCard", "PerfAccountant", "get_perf_accountant", "resolve_peaks",
 ]
 
 

@@ -290,6 +290,33 @@ class SLOBurnRateDetector(Detector):
         self._misses.clear()
 
 
+class HBMPressureDetector(Detector):
+    """Alerts when resident HBM (weights + paged KV + compiled-program
+    temp peak, from the performance accountant's pool gauges) exceeds
+    ``threshold`` of the device limit; re-arms below ``hysteresis``.
+    Backends with no memory limit (CPU) report fraction 0 and never fire."""
+
+    name = "hbm_pressure"
+    severity = "warning"
+
+    def __init__(self, threshold: float = 0.92, hysteresis: float = 0.85, **kw):
+        super().__init__(**kw)
+        self.threshold = float(threshold)
+        self.hysteresis = float(hysteresis)
+
+    def observe(self, fraction: float, **attrs) -> Optional[Alert]:
+        if not math.isfinite(fraction):
+            return None
+        if fraction > self.threshold:
+            return self._maybe_alert(
+                f"HBM pressure: {fraction:.0%} of device memory resident "
+                f"(threshold {self.threshold:.0%})",
+                fraction=round(float(fraction), 4), **attrs)
+        if fraction < self.hysteresis:
+            self._rearm()
+        return None
+
+
 # ---------------------------------------------------------------- monitor
 
 class HealthMonitor:
@@ -346,6 +373,11 @@ class HealthMonitor:
         d = self._detectors.get(SLOBurnRateDetector.name)
         if d is not None:
             self._dispatch(d.observe(float(ttft_s), float(tpot_s)))
+
+    def observe_hbm(self, fraction: float, **attrs) -> None:
+        d = self._detectors.get(HBMPressureDetector.name)
+        if d is not None:
+            self._dispatch(d.observe(float(fraction), **attrs))
 
     def on_event(self, ts, kind, uid, attrs) -> None:
         """EventLog listener: streams lifecycle events into detectors.

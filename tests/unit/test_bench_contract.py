@@ -99,6 +99,37 @@ def test_serve_rungs_compile_free_after_warmup(monkeypatch):
             f"{rung} timed window recompiled after warmup"
 
 
+def test_serve_rung_reports_perf_extras(monkeypatch):
+    """Every serve rung must report the performance-accounting extras
+    (model FLOPs, MFU, goodput, per-pool HBM bytes) on its result dict —
+    riding alongside the frozen contract, never inside it. Exercised on
+    the real run_serve path at CPU-smoke scale."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from deepspeed_tpu.models import TransformerConfig
+    from deepspeed_tpu.telemetry import get_perf_accountant
+
+    monkeypatch.setenv("DS_TPU_PERF_ACCOUNT", "1")
+    get_perf_accountant().reset()  # re-read the mode under this env
+    cfg_model = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                                  d_model=32, max_seq_len=128, norm="rmsnorm",
+                                  activation="swiglu", pos_emb="rope", tie_embeddings=False)
+    tps, extras = bench.run_serve(jax, jnp, np, cfg_model, 3, prompt_len=8, new_tokens=8)
+    assert tps > 0
+    assert extras["model_flops"] > 0
+    assert 0 < extras["goodput"] <= 1  # pow2 padding can only add slots
+    assert extras["mfu"] is None or extras["mfu"] >= 0  # None: no peak known (CPU)
+    hbm = extras["hbm"]
+    assert hbm["weights"] > 0 and hbm["kv_pages"] > 0
+    for k in ("prefix", "temp_peak", "pressure"):
+        assert k in hbm
+    # the rung also staged its full snapshot for the BENCH_PERF.json dump
+    snap = bench._PERF_EXTRA["serve"]
+    assert snap["cards"] and snap["totals"]["flops"] == extras["model_flops"]
+
+
 def test_disabled_telemetry_overhead_within_five_percent():
     """docs/OBSERVABILITY.md overhead guarantee: a hot loop with disabled
     telemetry stays within 5% of the same loop with no telemetry at all.

@@ -92,3 +92,69 @@ def test_ds_elastic_cli(tmp_path, capsys):
     for plan in out["plans"]:
         assert plan["micro_batch"] in (2, 4, 8)
         assert plan["micro_batch"] * plan["grad_accum"] * plan["chips"] == out["global_batch"]
+
+
+# --------------------------------------------------------- perf_report CLI
+
+def _load_perf_report():
+    import importlib.util
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "tools", "perf_report.py")
+    spec = importlib.util.spec_from_file_location("perf_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _perf_artifact(tmp_path):
+    """A BENCH_PERF.json built from a REAL accountant snapshot, so the
+    renderer is tested against the exact artifact shape bench.py dumps."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.telemetry import PerfAccountant
+
+    acct = PerfAccountant(mode=1, use_telemetry=False)
+    w = acct.wrap("fused", jax.jit(lambda a, b: a @ b), meta={"kind": "fused_step", "chunk": 8})
+    jax.block_until_ready(w(jnp.ones((8, 16), jnp.float32), jnp.ones((16, 4), jnp.float32)))
+    acct.attribute(useful_tokens=6, slot_tokens=8)
+    acct.note_spec(proposed=10, accepted=6)
+    acct.note_cow(4096)
+    acct.set_hbm(limit=10 ** 9, weights=10 ** 6, kv_pages=10 ** 5, prefix=10 ** 4)
+    p = tmp_path / "BENCH_PERF.json"
+    p.write_text(json.dumps({"rung": "serve", "snapshots": {"serve": acct.snapshot()}}))
+    return p
+
+
+def test_perf_report_renders_roofline(tmp_path, capsys):
+    mod = _load_perf_report()
+    p = _perf_artifact(tmp_path)
+    assert mod.main([str(p)]) == 0
+    out = capsys.readouterr().out
+    assert "== serve ==" in out
+    assert "fused[fused_step](chunk=8)" in out  # cost-card label with meta dims
+    assert "flops/call" in out and "bound" in out  # roofline table headers
+    assert "useful/slot tokens: 6/8" in out
+    assert "4 rejected" in out  # spec ledger line
+    assert "cow copies" in out
+    assert "pressure" in out and "hbm pools" in out
+
+
+def test_perf_report_rung_selection_and_json(tmp_path, capsys):
+    mod = _load_perf_report()
+    p = _perf_artifact(tmp_path)
+    assert mod.main([str(p), "--rung", "serve"]) == 0
+    capsys.readouterr()
+    assert mod.main([str(p), "--rung", "nope"]) == 1  # unknown rung: error, not silence
+    assert "not in artifact" in capsys.readouterr().err
+    assert mod.main([str(p), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["serve"]["cards"][0]["program"] == "fused"
+
+
+def test_perf_report_missing_file(tmp_path, capsys):
+    mod = _load_perf_report()
+    assert mod.main([str(tmp_path / "nope.json")]) == 1
+    assert "cannot read" in capsys.readouterr().err

@@ -46,6 +46,32 @@ def test_scan_multiplies_by_length():
     assert macs == 5 * (4 * 8 * 8)
 
 
+def test_scan_then_projection_golden_count():
+    """Golden count for a tiny fused-step-shaped program: L scanned layer
+    matmuls followed by an output projection — the shape the serving cost
+    cards price (telemetry/costs.py)."""
+    from deepspeed_tpu.profiling.flops_profiler import breakdown_of_fn
+
+    B, D, V, L = 4, 8, 32, 3
+    x = jnp.zeros((B, D), jnp.float32)
+    Wl = jnp.zeros((L, D, D), jnp.float32)
+    Wo = jnp.zeros((D, V), jnp.float32)
+
+    def fwd(x, Wl, Wo):
+        h, _ = jax.lax.scan(lambda c, w: (c @ w, None), x, Wl)
+        return h @ Wo
+
+    flops, macs = flops_of_fn(fwd, x, Wl, Wo)
+    assert flops == L * 2 * B * D * D + 2 * B * D * V
+    assert macs == L * B * D * D + B * D * V
+    # the breakdown attributes the scanned body to the scan's head
+    # primitive, already multiplied by trip count
+    f2, m2, bd = breakdown_of_fn(fwd, x, Wl, Wo)
+    assert (f2, m2) == (flops, macs)
+    assert bd["scan"] == L * 2 * B * D * D
+    assert bd["dot_general"] == 2 * B * D * V
+
+
 def test_counts_through_jit_and_grad():
     w = jnp.ones((8, 8), jnp.float32)
     x = jnp.ones((4, 8), jnp.float32)

@@ -563,6 +563,42 @@ def test_request_metrics_and_latency_summary():
     assert 0.0 < s["queue_time_fraction"] < 1.0
 
 
+def test_latency_summary_empty_stream():
+    """The bench rungs call latency_summary unconditionally; an empty
+    event window must yield zeros, not NaNs or IndexErrors."""
+    from deepspeed_tpu.telemetry import latency_summary
+    s = latency_summary([])
+    assert s["n_requests"] == 0.0 and s["n_complete"] == 0.0
+    assert s["ttft_p50_s"] == 0.0 and s["ttft_p99_s"] == 0.0
+    assert s["tpot_p50_s"] == 0.0 and s["tpot_p99_s"] == 0.0
+    assert s["queue_time_fraction"] == 0.0
+
+
+def test_latency_summary_single_request():
+    """One complete request: every percentile collapses to its sample,
+    and a one-token finish contributes no TPOT sample (not a div-by-zero)."""
+    from deepspeed_tpu.telemetry import latency_summary
+
+    def stream(n_new):
+        return [
+            {"kind": "enqueue", "uid": 1, "ts": 0.0},
+            {"kind": "admit", "uid": 1, "ts": 0.1},
+            {"kind": "first_token", "uid": 1, "ts": 0.3},
+            {"kind": "finish", "uid": 1, "ts": 0.5, "n_new": n_new},
+        ]
+
+    s = latency_summary(stream(3))
+    assert s["n_requests"] == 1.0 and s["n_complete"] == 1.0
+    assert s["ttft_p50_s"] == pytest.approx(0.3)
+    assert s["ttft_p99_s"] == pytest.approx(0.3)  # singleton: p99 == p50
+    assert s["tpot_p50_s"] == pytest.approx(0.2 / 2)  # (finish-first)/(n_new-1)
+    assert s["queue_time_fraction"] == pytest.approx(0.1 / 0.5)
+    # n_new == 1: TTFT is the whole story, TPOT has no samples
+    s1 = latency_summary(stream(1))
+    assert s1["n_complete"] == 1.0
+    assert s1["tpot_p50_s"] == 0.0 and s1["tpot_p99_s"] == 0.0
+
+
 # --------------------------------------------------------------- detectors
 
 def test_nonfinite_loss_detector_latch_and_cooldown():
