@@ -148,6 +148,25 @@ declare("DS_TPU_PREFIX_CACHE", "1", "bool",
         "Enable the radix prefix cache: retiring prompts donate KV blocks for reuse.",
         "inference/v2/ragged/manager.py")
 
+# Tiered KV economy (docs/SERVING.md "Tiered KV economy")
+declare("DS_TPU_KV_QUANT", "0", "int",
+        "KV-cache quantization bits: 8 stores K/V pages as int8 with per-block "
+        "per-head scales (fused dequant in the paged-attention kernels); 0 keeps "
+        "the engine dtype.",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_KV_SPILL", "0", "bool",
+        "Spill prefix-cache evictions to a host-RAM pool (async d2h) and re-admit "
+        "matched prefixes via h2d DMA instead of re-prefilling.",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_KV_HOST_POOL_MB", "256", "int",
+        "Capacity of the host-RAM KV spill pool in MiB (block count derives from "
+        "the per-block byte size of the device pools).",
+        "inference/v2/ragged/host_tier.py")
+declare("DS_TPU_KV_SPILL_WATERMARK", "0.1", "float",
+        "Free-block fraction below which the serving loop pre-spills LRU cached "
+        "blocks to the host tier between dispatches.",
+        "inference/v2/ragged/prefix_cache.py")
+
 # Runtime sanitizers (analysis/)
 declare("DS_TPU_KV_SANITIZE", "0", "bool",
         "Shadow-refcount sanitizer for paged KV blocks: traps double-free, "

@@ -20,11 +20,26 @@ table, and three invariant classes are trapped with precise messages:
 
 ``verify_against`` additionally detects shadow-vs-allocator refcount drift,
 which would indicate an allocator mutation that bypassed the public API.
+
+With the host spill tier (docs/SERVING.md "Tiered KV economy") three
+**residency** invariants join the mirror:
+
+- **dispatch-of-non-resident-block**: ``check_write`` (the same
+  dispatch-assembly hook) traps any block in the batch's table whose
+  residency is HOST or IN_FLIGHT — its HBM pages are gone or about to
+  be reused, so the kernel would read garbage;
+- **spill-of-shared-block**: ``on_spill`` (mirrored from
+  ``BlockedAllocator.mark_residency``) traps a spill of a block the
+  shadow table says has more than one holder — a live sequence could
+  still dispatch reads against it while the d2h is in flight;
+- **readmit-refcount drift**: ``check_readmit`` traps a re-admitted
+  block whose shadow and allocator counts disagree, or whose count is
+  not exactly the cache's single fresh hold.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Set
+from typing import Callable, Dict, Iterable, List, Optional, Set
 
 
 class KVSanitizerError(RuntimeError):
@@ -70,11 +85,46 @@ class ShadowRefcounts:
     def live_blocks(self) -> Set[int]:
         return set(self._rc)
 
+    # ------------------------------------------------------ residency hooks
+    def on_spill(self, block: int, allocator_rc: int) -> None:
+        """Trap a spill of a block some live sequence still shares."""
+        shadow = self._rc.get(block, 0)
+        if shadow != 1 or allocator_rc != 1:
+            raise KVSanitizerError(
+                f"KV sanitizer: spill of shared block {block} (allocator refcount "
+                f"{allocator_rc}, shadow {shadow}) — a live holder could dispatch "
+                "reads against its HBM pages while the d2h copy is in flight")
+
+    def check_readmit(self, block: int, allocator_rc: int) -> None:
+        """Trap refcount drift on a block just re-admitted from the host
+        tier: it must carry exactly the cache's single fresh hold."""
+        shadow = self._rc.get(block, 0)
+        if shadow != allocator_rc or shadow != 1:
+            raise KVSanitizerError(
+                f"KV sanitizer: readmit refcount drift on block {block}: "
+                f"allocator says {allocator_rc}, shadow table says {shadow} "
+                "(a re-admitted block must hold exactly the cache's one "
+                "reference before the caller retains it)")
+
     # ------------------------------------------------------------ checking
     def check_write(self, seq_uid: int, blocks: List[int], start_pos: int,
                     n_tokens: int, block_size: int,
-                    refcount_of) -> None:
-        """Trap a KV write into a block some other holder shares."""
+                    refcount_of,
+                    residency_of: Optional[Callable[[int], str]] = None) -> None:
+        """Trap a KV write into a block some other holder shares, and —
+        when residency tracking is on — any block in the dispatch's table
+        whose HBM pages are spilled (HOST) or mid-spill (IN_FLIGHT)."""
+        if residency_of is not None:
+            for idx, b in enumerate(blocks):
+                res = residency_of(b)
+                if res != "hbm":
+                    raise KVSanitizerError(
+                        f"KV sanitizer: sequence {seq_uid} is assembling a dispatch "
+                        f"over block {b} (table index {idx}) whose residency is "
+                        f"{res.upper()} — its HBM pages are "
+                        f"{'being copied out' if res == 'inflight' else 'released'}, "
+                        "so the kernel would read stale or reused memory; re-admit "
+                        "the block (h2d) before dispatching")
         if n_tokens <= 0:
             return
         first = start_pos // block_size

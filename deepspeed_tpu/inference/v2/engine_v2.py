@@ -33,6 +33,7 @@ from ...telemetry.events import get_event_log
 from ...telemetry.health import (HBMPressureDetector, QueueStallDetector,
                                  SLOBurnRateDetector, get_health_monitor)
 from ...utils.logging import log_dist, logger
+from ...ops.pallas.paged_attention import make_kv_pool
 from .model_runner import (make_burst_fn, make_fused_step_fn, make_spec_verify_fn,
                            make_step_fns)
 from .ragged.manager import DSStateManager, RaggedBatchConfig
@@ -74,6 +75,12 @@ class RaggedInferenceEngineConfig:
     quant_bits: int = 0  # 0 = off; 8, or 4 (TRUE packed int4 storage, 2 codes/byte)
     quant_group_size: int = 128
     quant_min_size: int = 4096  # leave smaller weights dense
+    # tiered KV economy (docs/SERVING.md): int8 paged-KV pools with fused
+    # in-kernel dequant, and a host-RAM spill tier behind the prefix cache
+    kv_quant_bits: Optional[int] = None  # 8 = int8 K/V pages + per-block-per-head
+    # scales (~4x blocks per HBM byte at fp32 baseline). None: DS_TPU_KV_QUANT.
+    kv_spill: Optional[bool] = None  # spill prefix-cache evictions to host RAM and
+    # re-admit matches via h2d DMA. None: off unless DS_TPU_KV_SPILL=1.
 
     @classmethod
     def from_dict(cls, d: Dict) -> "RaggedInferenceEngineConfig":
@@ -138,10 +145,29 @@ class InferenceEngineV2:
             # window models keep their pattern — the runner bakes one kernel
             # variant per distinct per-layer window value)
             run_cfg = dataclasses.replace(run_cfg, sliding_window=None, window_layers=None)
+        kvq = config.kv_quant_bits
+        if kvq is None:
+            kvq = knobs.get_int("DS_TPU_KV_QUANT")
+        if kvq not in (0, 8):
+            raise ValueError(f"kv_quant_bits must be 0 or 8, got {kvq}")
+        self._kv_quant_bits = int(kvq)
+        kv_spill = config.kv_spill
+        if kv_spill is None:
+            kv_spill = knobs.get_bool("DS_TPU_KV_SPILL")
+        self._kv_spill = bool(kv_spill)
+        if self._tp > 1 and (self._kv_quant_bits or self._kv_spill):
+            # the int8 pool is a (codes, scales) pytree and the spill
+            # gather/scatter assume single-device pools; the shard_map
+            # in_specs and host slabs would both need per-shard layouts
+            raise ValueError("kv_quant_bits / kv_spill do not compose with "
+                             f"tensor_parallel={self._tp} yet")
         n_blocks = smc.num_kv_blocks
         if n_blocks is None:
-            bytes_per_block = (2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * cfg.head_dim *
-                               jnp.dtype(self.dtype).itemsize)
+            # int8 pages: one byte per element plus a 4-byte f32 scale per
+            # (slot, kv head) — head_dim + 4 bytes per slot-head
+            slot_head_bytes = (cfg.head_dim + 4) if self._kv_quant_bits == 8 else \
+                cfg.head_dim * jnp.dtype(self.dtype).itemsize
+            bytes_per_block = 2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * slot_head_bytes
             n_blocks = max(8, int(smc.memory_gb * (1 << 30) // bytes_per_block))
         self.state = DSStateManager(smc, n_blocks, enable_prefix_cache=config.enable_prefix_cache)
         self.scheduler = RaggedBatchScheduler(self.state, max_batch_tokens=smc.max_ragged_batch_size,
@@ -176,6 +202,8 @@ class InferenceEngineV2:
         # per-pool HBM gauges feeding the pressure detector
         self._acct = get_perf_accountant()
         self._m_cow_bytes = tele.counter("kv_cow_bytes_total")
+        # expected RMS dequant error of the int8 KV pool (0.0 when off)
+        self._m_quant_err = tele.gauge("kv_quant_dequant_error")
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -183,12 +211,33 @@ class InferenceEngineV2:
         self.state.register_sanitizer_root(self._garbage_block)
 
         L, bs = cfg.n_layers, smc.kv_block_size
-        self.k_pages = jnp.zeros((L, n_blocks, bs, cfg.kv_heads, cfg.head_dim), self.dtype)
-        self.v_pages = jnp.zeros_like(self.k_pages)
+        pool_shape = (L, n_blocks, bs, cfg.kv_heads, cfg.head_dim)
+        self.k_pages = make_kv_pool(pool_shape, self.dtype, self._kv_quant_bits)
+        self.v_pages = make_kv_pool(pool_shape, self.dtype, self._kv_quant_bits)
         self._max_blocks_per_seq = -(-smc.max_context // bs)
-        # K+V bytes one block holds across every layer — the unit of COW
-        # copy traffic and of prefix-cache-held HBM
-        self._block_bytes = (self.k_pages.nbytes + self.v_pages.nbytes) // n_blocks
+        # K+V bytes one block holds across every layer (codes + scales for
+        # the int8 pool) — the unit of COW copy traffic, of prefix-cache-
+        # held HBM, and of host-tier slot sizing
+        self._block_bytes = sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(
+            (self.k_pages, self.v_pages))) // n_blocks
+        # host spill tier (docs/SERVING.md "Tiered KV economy"): the prefix
+        # cache demotes LRU evictions to a host-RAM pool through a dedicated
+        # d2h thread and re-admits radix matches via jitted h2d scatter
+        self._gather_fn = None   # lazily-jitted per-block pool gather (spill snapshot)
+        self._readmit_fn = None  # lazily-jitted donated h2d scatter (re-admission)
+        self._spill_mgr = None
+        if self._kv_spill and self.state.prefix_cache is not None:
+            from .ragged.host_tier import HostKVPool, SpillManager
+
+            leaves = jax.tree_util.tree_leaves((self.k_pages, self.v_pages))
+            host_pool = HostKVPool(
+                max(1, (knobs.get_int("DS_TPU_KV_HOST_POOL_MB") << 20) // max(1, self._block_bytes)),
+                [leaf.shape[:1] + leaf.shape[2:] for leaf in leaves],  # drop the block axis
+                [leaf.dtype for leaf in leaves])
+            self._spill_mgr = SpillManager(host_pool, self._gather_block)
+            self.state.prefix_cache.attach_spill_tier(
+                self._spill_mgr, self._readmit_block,
+                watermark_blocks=int(knobs.get_float("DS_TPU_KV_SPILL_WATERMARK") * n_blocks))
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.tree_util.tree_map(cast, params)
@@ -256,7 +305,9 @@ class InferenceEngineV2:
         self._rng = jax.random.PRNGKey(0)
         self._update_hbm_gauges()
         log_dist(f"InferenceEngineV2: {n_blocks} KV blocks x {bs} tokens "
-                 f"({n_blocks * bs} cached tokens), dtype={config.dtype}", ranks=[0])
+                 f"({n_blocks * bs} cached tokens), dtype={config.dtype}"
+                 + (f", kv_quant=int{self._kv_quant_bits}" if self._kv_quant_bits else "")
+                 + (", kv_spill=host" if self._spill_mgr is not None else ""), ranks=[0])
 
     _MAX_BURST_VARIANTS = 8
 
@@ -403,10 +454,15 @@ class InferenceEngineV2:
         """Copy-on-write page copy: duplicate block ``src`` into ``dst``
         across every layer's K/V pool. Jitted with donation so the pools
         update in place; src/dst are traced scalars, so one compiled
-        program serves every copy."""
+        program serves every copy. The tree_map makes one program cover
+        both pool representations: a plain page array, or the int8
+        ``(codes, scales)`` pytree — a COW'd quantized block copies its
+        scale plane with its codes, so dequant stays exact."""
         if self._cow_fn is None:
+            copy_at = lambda pool, s, d: jax.tree_util.tree_map(
+                lambda p: p.at[:, d].set(p[:, s]), pool)
             self._cow_fn = jax.jit(
-                lambda kp, vp, s, d: (kp.at[:, d].set(kp[:, s]), vp.at[:, d].set(vp[:, s])),
+                lambda kp, vp, s, d: (copy_at(kp, s, d), copy_at(vp, s, d)),
                 donate_argnums=(0, 1))
             # timed=False: COW dispatches inside another quantum's window,
             # so it must not steal that quantum's time attribution — its
@@ -420,6 +476,40 @@ class InferenceEngineV2:
 
     def _cow_ready(self, seq, start_pos: int) -> None:
         self.state.ensure_writable(seq, start_pos, self._copy_block)
+
+    # ----------------------------------------------------- host spill tier
+    def _gather_block(self, block: int):
+        """Device snapshot of one block's pages across every pool leaf —
+        independent buffers, so the spill thread's later d2h readback
+        cannot race the donated in-place pool updates that follow. The
+        block id is traced: one compiled program serves every spill."""
+        if self._gather_fn is None:
+            fn = jax.jit(lambda pools, b: [p[:, b] for p in jax.tree_util.tree_leaves(pools)])
+            # timed=False: like the COW copy, the gather dispatches inside
+            # another quantum's attribution window
+            fn = self._acct.wrap("kv_spill_gather", fn, timed=False)
+            if self.jit_auditor is not None:
+                fn = self.jit_auditor.wrap("kv_spill_gather", fn)
+            self._gather_fn = fn
+        return self._gather_fn((self.k_pages, self.v_pages), block)
+
+    def _readmit_block(self, block: int, host_leaves) -> None:
+        """Re-admission h2d: scatter one host-tier block's leaves back
+        into the device pools at ``block``. Donated like the COW copy so
+        the pools update in place; the host buffers ride the dispatch as
+        ordinary operands (the transfer IS the DMA)."""
+        if self._readmit_fn is None:
+            def scat(pools, b, bufs):
+                flat, treedef = jax.tree_util.tree_flatten(pools)
+                return jax.tree_util.tree_unflatten(
+                    treedef, [p.at[:, b].set(u) for p, u in zip(flat, bufs)])
+            fn = jax.jit(scat, donate_argnums=(0,))
+            fn = self._acct.wrap("kv_readmit", fn, timed=False)
+            if self.jit_auditor is not None:
+                fn = self.jit_auditor.wrap("kv_readmit", fn)
+            self._readmit_fn = fn
+        self.k_pages, self.v_pages = self._readmit_fn(
+            (self.k_pages, self.v_pages), block, list(host_leaves))
 
     def _run_prefill_batch(self, uids: List[int], token_lists: List[List[int]], S: int,
                            return_tokens: bool = False, defer: bool = False):
@@ -990,15 +1080,19 @@ class InferenceEngineV2:
 
     def _update_hbm_gauges(self) -> None:
         """Refresh the per-pool HBM gauges (weights, paged KV, prefix-held
-        blocks, compiled-program temp peak) and feed the pressure detector.
-        Pure host arithmetic over already-known sizes — no device sync."""
+        blocks, host-tier bytes, compiled-program temp peak) and feed the
+        pressure detector. Pure host arithmetic over already-known sizes —
+        no device sync, except the one-scalar dequant-error readback when
+        the int8 KV pool is on (once per generate, off the dispatch path)."""
         if not self._acct.enabled:
             return
         weights = sum(int(getattr(x, "nbytes", 0))
                       for x in jax.tree_util.tree_leaves(self.params))
-        pages = int(self.k_pages.nbytes) + int(self.v_pages.nbytes)
+        pages = sum(int(x.nbytes) for x in jax.tree_util.tree_leaves(
+            (self.k_pages, self.v_pages)))
         pc = self.state.prefix_cache
         prefix = pc.cached_blocks * self._block_bytes if pc is not None else 0
+        host_spill = pc.host_tier_bytes if pc is not None else 0
         limit = 0
         try:
             stats = jax.devices()[0].memory_stats() or {}
@@ -1006,9 +1100,18 @@ class InferenceEngineV2:
         except Exception:
             pass  # CPU/interpret backends expose no memory stats
         pressure = self._acct.set_hbm(limit=limit, weights=weights,
-                                      kv_pages=pages, prefix=prefix)
+                                      kv_pages=pages, prefix=prefix,
+                                      host_spill=host_spill)
         self._health.observe_hbm(pressure, weights_bytes=weights,
                                  kv_pages_bytes=pages)
+        if self._kv_quant_bits == 8:
+            # expected RMS dequant error of live pages: a uniform quantizer
+            # with step = scale has RMS error scale/sqrt(12); average over
+            # written (scale > 0) slot-heads of both pools
+            s = jnp.concatenate([self.k_pages[1].ravel(), self.v_pages[1].ravel()])
+            live = s > 0
+            err = jnp.sum(jnp.where(live, s, 0.0)) / jnp.maximum(1, jnp.sum(live)) / (12.0 ** 0.5)
+            self._m_quant_err.set(float(err))  # graft-lint: readback (one scalar, per generate)
 
     def _commit_closures(self, reqs, results, pieces, counts, decode_ready, eos_token_id, on_token):
         """(commit, commit_dev) shared by the fused and unfused loops."""
@@ -1100,6 +1203,9 @@ class InferenceEngineV2:
 
         while pending or decode_ready:
             self._health.poll()
+            # host-tier pre-spill: start d2h demotions while the pool is
+            # under the spill watermark so they overlap the next dispatch
+            self.state.spill_tick()
             if self._spec_enabled and decode_ready and not pending:
                 # pure-decode situation: try a draft→verify quantum. Rows
                 # the drafter/scheduler skipped stay in decode_ready and
@@ -1163,6 +1269,8 @@ class InferenceEngineV2:
 
         while pending or decode_ready:
             self._health.poll()
+            # host-tier pre-spill (see _generate_fused)
+            self.state.spill_tick()
             if self._spec_enabled and not pending and decode_ready:
                 # pure-decode situation: draft→verify quantum first; on a
                 # dry drafter fall through to the burst / stepped path

@@ -41,8 +41,9 @@ except ImportError:  # pragma: no cover — older jax: experimental namespace
 from jax.sharding import PartitionSpec as P
 
 from ...models.transformer import (TransformerConfig, alibi_slopes, apply_rope, scaled_rope_frequencies)
-from ...ops.pallas.paged_attention import (paged_attention_decode, paged_attention_mixed,
-                                           paged_attention_prefill, update_kv_pages)
+from ...ops.pallas.paged_attention import (kv_layer, kv_set_layer, paged_attention_decode,
+                                           paged_attention_mixed, paged_attention_prefill,
+                                           update_kv_pages)
 from ...ops.registry import REGISTRY
 from .modules import _norm_p, _proj, build_modules
 
@@ -142,7 +143,9 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
                    interpret: bool = False, mesh=None, tp: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One engine step over the paged cache.
 
-    input_ids/positions: (B, S); k_pages/v_pages: (L, N, bs, KVH, D);
+    input_ids/positions: (B, S); k_pages/v_pages: (L, N, bs, KVH, D) — or
+    the int8 ``(codes, scales)`` pools (``kv_quant_bits=8``), which thread
+    through every program here as a pytree with unchanged signatures;
     block_tables: (B, P); ctx_lens: (B,) context length *including* the
     current tokens; slot_mapping: (B*S,) flat KV slots for the new tokens;
     last_token_idx: (B,) index of the last real (non-pad) token per row.
@@ -171,10 +174,11 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
                                   slopes=slopes, decode_attn=_da, decode_native=_dn,
                                   prefill_attn=_pa, window=_w)
 
-        x, kp, vp = _transformer_layer(cfg, lp, x, k_pages[i], v_pages[i], slot_mapping, cos, sin,
-                                       positions, attn_apply, mods, _is_moe_layer(cfg, i))
-        k_pages = k_pages.at[i].set(kp)
-        v_pages = v_pages.at[i].set(vp)
+        x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
+                                       slot_mapping, cos, sin, positions, attn_apply, mods,
+                                       _is_moe_layer(cfg, i))
+        k_pages = kv_set_layer(k_pages, i, kp)
+        v_pages = kv_set_layer(v_pages, i, vp)
 
     return mods.unembed(cfg, params, x, last_token_idx), k_pages, v_pages
 
@@ -218,10 +222,11 @@ def fused_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, 
                                         decode_fn=_da, prefill_fn=_pa, native=_dn)
             return out[None]  # (1, T, H, D)
 
-        x, kp, vp = _transformer_layer(cfg, lp, x, k_pages[i], v_pages[i], slot_mapping, cos, sin,
-                                       pos2d, attn_apply, mods, _is_moe_layer(cfg, i))
-        k_pages = k_pages.at[i].set(kp)
-        v_pages = v_pages.at[i].set(vp)
+        x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
+                                       slot_mapping, cos, sin, pos2d, attn_apply, mods,
+                                       _is_moe_layer(cfg, i))
+        k_pages = kv_set_layer(k_pages, i, kp)
+        v_pages = kv_set_layer(v_pages, i, vp)
 
     # per-row last-token hidden states -> (N, 1, d) so the unembed module's
     # (batch, seq) contract holds for the ragged flat batch
@@ -266,10 +271,11 @@ def spec_verify_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.nda
                                         decode_fn=_da, prefill_fn=_pa, native=_dn)
             return out[None]  # (1, T, H, D)
 
-        x, kp, vp = _transformer_layer(cfg, lp, x, k_pages[i], v_pages[i], slot_mapping, cos, sin,
-                                       pos2d, attn_apply, mods, _is_moe_layer(cfg, i))
-        k_pages = k_pages.at[i].set(kp)
-        v_pages = v_pages.at[i].set(vp)
+        x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
+                                       slot_mapping, cos, sin, pos2d, attn_apply, mods,
+                                       _is_moe_layer(cfg, i))
+        k_pages = kv_set_layer(k_pages, i, kp)
+        v_pages = kv_set_layer(v_pages, i, vp)
 
     # unembed every flat position: (T, 1, d) rows through the module's
     # (batch, seq) contract — T is small (rows x (K+1)), so the full

@@ -15,9 +15,32 @@ LIFO (recently-freed, still-warm blocks are reused first) and the
 double-free check is a set membership test, O(1) per freed block instead
 of scanning the free list. An optional eviction hook lets a cache give
 blocks back under allocation pressure before ``allocate`` gives up.
+
+With the host spill tier (``host_tier.py``, docs/SERVING.md "Tiered KV
+economy") every block additionally carries a **residency** state:
+
+- ``RES_HBM`` — the block's pages are live in the device pool (the only
+  state in which its KV may be read or written by a dispatch);
+- ``RES_INFLIGHT`` — the prefix cache snapshotted the block and its d2h
+  copy is queued/running on the spill thread; the HBM block is still
+  allocated (the snapshot is an independent buffer, but the id must not
+  be handed to a new owner until the copy lands);
+- ``RES_HOST`` — the copy landed and the HBM block was released; the
+  state is informational until ``allocate`` hands the id out again
+  (which resets it to ``RES_HBM`` — the new owner writes fresh pages).
+
+The allocator only *records* residency (``mark_residency``/
+``residency``); the prefix cache drives the transitions and the KV
+sanitizer (``analysis/kv_sanitizer.py``) traps dispatches that would
+read a non-HBM block.
 """
 
 from typing import Callable, Iterable, List, Optional, Union
+
+# residency states (host spill tier)
+RES_HBM = "hbm"
+RES_INFLIGHT = "inflight"
+RES_HOST = "host"
 
 
 class BlockedAllocator:
@@ -30,6 +53,7 @@ class BlockedAllocator:
         self._free: List[int] = list(range(num_blocks - 1, -1, -1))
         self._free_set = set(self._free)  # O(1) membership for the double-free check
         self._refcount = [0] * num_blocks
+        self._residency = [RES_HBM] * num_blocks
         self._evict_hook: Optional[Callable[[int], None]] = None
         # optional shadow-refcount sanitizer (analysis/kv_sanitizer.py):
         # mirrors every allocate/retain/release and traps invariant breaks
@@ -47,9 +71,31 @@ class BlockedAllocator:
     def refcount(self, block: int) -> int:
         return self._refcount[block]
 
+    def residency(self, block: int) -> str:
+        return self._residency[block]
+
+    def mark_residency(self, block: int, state: str) -> None:
+        """Record a residency transition (driven by the prefix cache's
+        spill machinery). ``RES_INFLIGHT`` is only legal on an unshared
+        live block: a shared block's other holder could dispatch reads
+        while the d2h is in flight."""
+        if state not in (RES_HBM, RES_INFLIGHT, RES_HOST):
+            raise ValueError(f"unknown residency state {state!r}")
+        if state == RES_INFLIGHT:
+            if self._sanitizer is not None:
+                self._sanitizer.on_spill(block, self._refcount[block])
+            if self._refcount[block] != 1:
+                raise ValueError(f"cannot spill block {block}: refcount "
+                                 f"{self._refcount[block]} != 1")
+        self._residency[block] = state
+
     def set_sanitizer(self, sanitizer) -> None:
         """Install a ``ShadowRefcounts`` mirror (``DS_TPU_KV_SANITIZE``)."""
         self._sanitizer = sanitizer
+
+    @property
+    def sanitizer(self):
+        return self._sanitizer
 
     def set_eviction_hook(self, hook: Optional[Callable[[int], None]]) -> None:
         """``hook(shortfall)`` is called when ``allocate`` is short by
@@ -71,6 +117,10 @@ class BlockedAllocator:
             b = self._free.pop()
             self._free_set.discard(b)
             self._refcount[b] = 1
+            # a re-issued id starts a fresh HBM life: the new owner writes
+            # its own pages (any prior host copy belongs to the cache node
+            # that spilled it, keyed by host slot, not by this id)
+            self._residency[b] = RES_HBM
             out.append(b)
         if self._sanitizer is not None:
             self._sanitizer.on_allocate(out)

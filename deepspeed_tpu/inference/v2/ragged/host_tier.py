@@ -1,0 +1,182 @@
+"""Host-RAM spill tier for paged KV blocks.
+
+The prefix cache (``prefix_cache.py``) turns eviction from "forget" into
+"demote": instead of freeing an LRU unshared block's KV, the block's
+pages are snapshotted on device (one jitted gather, traced block id) and
+copied device-to-host by a dedicated spill thread, double-buffered the
+way ``runtime/swap_tensor/async_swapper.py`` overlaps its partition
+swaps: the engine thread only *dispatches* the snapshot and enqueues it;
+the blocking ``np.asarray`` readback runs on the worker while the device
+keeps decoding. A later radix ``match`` that lands on a spilled node
+re-admits the block via h2d DMA (one jitted scatter) instead of
+re-running prefill.
+
+Split of responsibility: this module is pure *mechanism* — a
+preallocated host slab with a slot free-list (:class:`HostKVPool`) and
+the d2h worker (:class:`SpillManager`). All *policy* (which node spills,
+when to drop host-LRU entries, residency bookkeeping against the
+allocator) lives in ``prefix_cache.py``, which owns the radix tree the
+decisions are about.
+
+Locking: the worker hand-off is a ``threading.Condition`` around two
+deques. The d2h copy itself never runs under the condition — blocking
+device syncs under a held lock are exactly what graft-lint's
+``lock-order`` check rejects — and the engine-side waits use
+``Condition.wait_for`` (which releases the lock while sleeping).
+
+The slabs are plain page-aligned numpy buffers: JAX's public API exposes
+no pinned-host allocator, so "pinned" here means *preallocated and
+reused* — the steady state does no host allocation, which is what keeps
+the d2h/h2d path rate-stable.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["HostKVPool", "SpillManager"]
+
+
+class HostKVPool:
+    """Fixed-capacity host slab holding per-block KV slices.
+
+    One slab per device-pool leaf (two for a plain fp32/bf16 pool pair,
+    four when the pools are int8 ``(codes, scales)`` tuples — spilled
+    blocks stay quantized, so the host tier gets the same ~4x capacity
+    win as HBM). Slot ``i`` of every slab together holds one block's KV
+    across all layers.
+    """
+
+    def __init__(self, capacity_blocks: int,
+                 leaf_shapes: Sequence[Tuple[int, ...]],
+                 leaf_dtypes: Sequence) -> None:
+        if capacity_blocks < 0:
+            raise ValueError(f"capacity_blocks must be >= 0, got {capacity_blocks}")
+        self._capacity = int(capacity_blocks)
+        self._slabs: List[np.ndarray] = [
+            np.zeros((self._capacity,) + tuple(shape), dtype)
+            for shape, dtype in zip(leaf_shapes, leaf_dtypes)
+        ]
+        # LIFO free list, same discipline as BlockedAllocator: a just-
+        # freed (cache-warm) slot is reused first
+        self._free: List[int] = list(range(self._capacity - 1, -1, -1))
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_slots(self) -> int:
+        return self._capacity - len(self._free)
+
+    @property
+    def bytes_per_slot(self) -> int:
+        return sum(int(s[0:1].nbytes) for s in self._slabs) if self._capacity else 0
+
+    @property
+    def used_bytes(self) -> int:
+        return self.used_slots * self.bytes_per_slot
+
+    def try_alloc_slot(self) -> Optional[int]:
+        return self._free.pop() if self._free else None
+
+    def free_slot(self, slot: int) -> None:
+        if not (0 <= slot < self._capacity):
+            raise ValueError(f"host slot {slot} out of range")
+        if slot in self._free:
+            raise ValueError(f"double free of host slot {slot}")
+        self._free.append(slot)
+
+    def write(self, slot: int, leaves: Sequence) -> None:
+        """Copy one block's device leaves into ``slot`` — the blocking
+        d2h readback. Runs on the spill worker, never the engine thread."""
+        for slab, leaf in zip(self._slabs, leaves):
+            slab[slot] = np.asarray(leaf)
+
+    def read(self, slot: int) -> List[np.ndarray]:
+        """Host views of ``slot``'s leaves (the h2d scatter consumes them
+        immediately, so views — not copies — are safe)."""
+        return [slab[slot] for slab in self._slabs]
+
+
+class SpillManager:
+    """Dedicated d2h worker: the engine enqueues (block, slot, device
+    snapshot) triples; the worker copies them to the host pool and
+    reports landings back. ``gather_fn(block)`` (an engine closure over
+    the jitted pool gather) produces the snapshot on the *engine* thread
+    so device dispatch order stays single-threaded — the worker only
+    ever reads the resulting independent buffers."""
+
+    def __init__(self, pool: HostKVPool,
+                 gather_fn: Callable[[int], Sequence]) -> None:
+        self._pool = pool
+        self._gather = gather_fn
+        self._cond = threading.Condition()
+        self._queue: deque = deque()   # (block, slot, device leaves)
+        self._landed: deque = deque()  # (block, slot)
+        self._inflight = 0
+        self._stop = False
+        self._thread = threading.Thread(target=self._worker, daemon=True,
+                                        name="kv-spill-d2h")
+        self._thread.start()
+
+    @property
+    def pool(self) -> HostKVPool:
+        return self._pool
+
+    @property
+    def inflight(self) -> int:
+        with self._cond:
+            return self._inflight
+
+    def spill_async(self, block: int, slot: int) -> None:
+        """Snapshot ``block`` (async device dispatch) and enqueue its d2h."""
+        leaves = self._gather(block)
+        with self._cond:
+            self._queue.append((block, slot, leaves))
+            self._inflight += 1
+            self._cond.notify_all()
+
+    def drain(self) -> List[Tuple[int, int]]:
+        """Collect every landed (block, slot) pair; never blocks."""
+        with self._cond:
+            out = list(self._landed)
+            self._landed.clear()
+        return out
+
+    def wait_all(self, timeout: float = 60.0) -> bool:
+        """Block until every enqueued d2h has landed. ``wait_for``
+        releases the condition while sleeping, so no allocator/cache
+        state is held across the wait."""
+        with self._cond:
+            return self._cond.wait_for(lambda: self._inflight == 0, timeout=timeout)
+
+    def _worker(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait()
+                if not self._queue:
+                    return  # stop requested and nothing left to flush
+                block, slot, leaves = self._queue.popleft()
+            # the blocking readback happens OUTSIDE the condition: the
+            # engine can keep enqueueing while this copy runs
+            self._pool.write(slot, leaves)
+            with self._cond:
+                self._landed.append((block, slot))
+                self._inflight -= 1
+                self._cond.notify_all()
+
+    def close(self, timeout: float = 10.0) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout)

@@ -98,11 +98,23 @@ class DSStateManager:
     def available_blocks(self) -> int:
         """Free blocks plus cached blocks eviction could reclaim right
         now — the number admission accounting may plan against (the
-        allocator evicts on demand through the pressure hook)."""
+        allocator evicts on demand through the pressure hook). With the
+        host spill tier this includes spillable and mid-spill blocks
+        (``reclaimable_blocks`` counts both): a pressured allocate waits
+        for in-flight d2h copies to land and drains them, so planning
+        against them cannot deadlock admission."""
         n = self._allocator.free_blocks
         if self._prefix_cache is not None:
             n += self._prefix_cache.reclaimable_blocks()
         return n
+
+    def spill_tick(self) -> int:
+        """Forward one watermark pre-spill tick to the prefix cache's
+        host tier (no-op when detached) — called by the serving loops
+        between dispatches so d2h copies overlap device compute."""
+        if self._prefix_cache is None:
+            return 0
+        return self._prefix_cache.spill_tick()
 
     @property
     def max_context(self) -> int:
@@ -224,7 +236,8 @@ class DSStateManager:
         if self._sanitizer is None:
             return
         self._sanitizer.check_write(seq.uid, seq.blocks, start_pos, n_tokens,
-                                    self.block_size, self._allocator.refcount)
+                                    self.block_size, self._allocator.refcount,
+                                    residency_of=self._allocator.residency)
 
     def sanitize_verify(self) -> None:
         """Full invariant sweep: shadow-vs-allocator drift plus the
@@ -236,7 +249,10 @@ class DSStateManager:
         for seq in self._seqs.values():
             reachable.update(seq.blocks)
         if self._prefix_cache is not None:
-            reachable.update(n.block for n in self._prefix_cache._iter_nodes())
+            # spilled nodes (block == -1, KV on the host tier) hold no
+            # HBM block — they are excluded from reachability on purpose
+            reachable.update(n.block for n in self._prefix_cache._iter_nodes()
+                             if n.block >= 0)
         allocated = [b for b, rc in enumerate(self._allocator._refcount) if rc > 0]
         self._sanitizer.check_leaks(allocated, reachable)
 

@@ -18,6 +18,17 @@ rotary, ``linear_blocked_kv_rotary/``). TPU re-design:
 New KV entries are written with ``update_kv_pages`` via a flat
 "slot mapping" (token -> block*block_size+offset), computed host-side by
 the engine.
+
+int8 paged KV (``kv_quant_bits=8``): a pool is the pytree
+``(codes int8 (N, bs, KVH, D), scales f32 (N, bs, KVH))`` — one symmetric
+per-slot-per-head scale, i.e. per-block (bs, KVH) scale planes. Scales are
+per *slot* rather than one scalar per block-head so quantize-on-append and
+spec-decode rollback stay local: overwriting a slot rewrites its scale and
+never re-quantizes neighbours, so dequantized history is independent of
+rejected drafts. Every entry point below accepts either representation;
+the Pallas kernels fuse the dequant in VMEM following the
+``quantized_matmul.py`` idiom (int8 stream from HBM, ``codes * scale`` next
+to the dot).
 """
 
 import functools
@@ -38,15 +49,83 @@ NEG_INF = -1e30
 
 
 # ------------------------------------------------------------------
+# int8 pool representation
+# ------------------------------------------------------------------
+def kv_pool_is_quantized(pool) -> bool:
+    """True when ``pool`` is the int8 ``(codes, scales)`` pytree."""
+    return isinstance(pool, tuple)
+
+
+def kv_pool_shape(pool) -> Tuple[int, ...]:
+    """(..., bs, KVH, D) of a pool, plain array or ``(codes, scales)``."""
+    return (pool[0] if isinstance(pool, tuple) else pool).shape
+
+
+def make_kv_pool(shape: Tuple[int, ...], dtype, kv_quant_bits: int = 0):
+    """Allocate one KV page pool of ``shape`` = (..., bs, KVH, D): a plain
+    array, or at ``kv_quant_bits=8`` the ``(int8 codes, f32 scales)`` pair
+    with per-slot-per-head scale planes ``shape[:-1]``."""
+    if kv_quant_bits == 8:
+        return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape[:-1], jnp.float32))
+    if kv_quant_bits:
+        raise ValueError(f"kv_quant_bits must be 0 or 8, got {kv_quant_bits}")
+    return jnp.zeros(shape, dtype)
+
+
+def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-(slot, kv-head) int8: (..., KVH, D) -> codes of the
+    same shape + f32 scales (..., KVH). ``quantize_weight_kgroups`` idiom:
+    all-zero rows keep scale 1.0 so dequant is exact there too."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = jnp.where(amax == 0, 1.0, amax / 127.0)
+    codes = jnp.clip(jnp.round(xf / scales[..., None]), -128, 127).astype(jnp.int8)
+    return codes, scales
+
+
+def dequantize_kv(pool) -> jnp.ndarray:
+    """f32 view of an int8 ``(codes, scales)`` pool (oracle/debug path)."""
+    codes, scales = pool
+    return codes.astype(jnp.float32) * scales[..., None]
+
+
+def kv_layer(pool, i: int):
+    """Per-layer slice of a stacked (L, ...) pool, plain or quantized."""
+    if isinstance(pool, tuple):
+        return tuple(p[i] for p in pool)
+    return pool[i]
+
+
+def kv_set_layer(pool, i: int, new):
+    """Functional per-layer write-back, the ``pool.at[i].set(new)`` of
+    both representations."""
+    if isinstance(pool, tuple):
+        return tuple(p.at[i].set(n) for p, n in zip(pool, new))
+    return pool.at[i].set(new)
+
+
+# ------------------------------------------------------------------
 # KV page update
 # ------------------------------------------------------------------
-def update_kv_pages(k_pages: jnp.ndarray, v_pages: jnp.ndarray, k_new: jnp.ndarray, v_new: jnp.ndarray,
-                    slot_mapping: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def update_kv_pages(k_pages, v_pages, k_new: jnp.ndarray, v_new: jnp.ndarray,
+                    slot_mapping: jnp.ndarray):
     """Scatter new KV entries into the page pool.
 
-    k_pages/v_pages: (N, bs, KVH, D); k_new/v_new: (T, KVH, D);
-    slot_mapping: (T,) int32 flat slot = block_id * bs + offset.
+    k_pages/v_pages: (N, bs, KVH, D) — or the quantized ``(codes, scales)``
+    pair, in which case the new entries are quantized on append, in-graph;
+    k_new/v_new: (T, KVH, D); slot_mapping: (T,) int32 flat slot =
+    block_id * bs + offset.
     """
+    if isinstance(k_pages, tuple):
+        (kc, ks), (vc, vs) = k_pages, v_pages
+        n, bs, kvh, d = kc.shape
+        k_q, k_s = quantize_kv(k_new)
+        v_q, v_s = quantize_kv(v_new)
+        kc = kc.reshape(n * bs, kvh, d).at[slot_mapping].set(k_q).reshape(n, bs, kvh, d)
+        vc = vc.reshape(n * bs, kvh, d).at[slot_mapping].set(v_q).reshape(n, bs, kvh, d)
+        ks = ks.reshape(n * bs, kvh).at[slot_mapping].set(k_s).reshape(n, bs, kvh)
+        vs = vs.reshape(n * bs, kvh).at[slot_mapping].set(v_s).reshape(n, bs, kvh)
+        return (kc, ks), (vc, vs)
     n, bs, kvh, d = k_pages.shape
     flat_k = k_pages.reshape(n * bs, kvh, d)
     flat_v = v_pages.reshape(n * bs, kvh, d)
@@ -72,13 +151,22 @@ def paged_attention_ref(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.ndarr
     Returns (B, S, H, D).
     """
     B, S, H, D = q.shape
-    _, bs, KVH, _ = k_pages.shape
+    _, bs, KVH, _ = kv_pool_shape(k_pages)
     P = block_tables.shape[1]
     G = H // KVH
     scale = scale if scale is not None else D**-0.5
 
-    k = k_pages[block_tables].reshape(B, P * bs, KVH, D)  # (B, L, KVH, D)
-    v = v_pages[block_tables].reshape(B, P * bs, KVH, D)
+    if isinstance(k_pages, tuple):
+        # gather int8 codes + scale planes for the live pages only, then
+        # dequantize the (small) dense view — the oracle the kernels chase
+        (kc, ksc), (vc, vsc) = k_pages, v_pages
+        k = (kc[block_tables].reshape(B, P * bs, KVH, D).astype(jnp.float32)
+             * ksc[block_tables].reshape(B, P * bs, KVH)[..., None])
+        v = (vc[block_tables].reshape(B, P * bs, KVH, D).astype(jnp.float32)
+             * vsc[block_tables].reshape(B, P * bs, KVH)[..., None])
+    else:
+        k = k_pages[block_tables].reshape(B, P * bs, KVH, D)  # (B, L, KVH, D)
+        v = v_pages[block_tables].reshape(B, P * bs, KVH, D)
     L = P * bs
 
     qf = q.astype(jnp.float32).reshape(B, S, KVH, G, D) * scale
@@ -158,9 +246,14 @@ def paged_attention_mixed(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nda
 # ------------------------------------------------------------------
 # Pallas decode kernel
 # ------------------------------------------------------------------
-def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref, acc_ref, m_ref, l_ref,
-                   *, bs: int, kvh: int, g: int, d: int, pages: int, scale: float, has_alibi: bool = False,
-                   window: int = 0):
+def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, *rest,
+                   bs: int, kvh: int, g: int, d: int, pages: int, scale: float, has_alibi: bool = False,
+                   window: int = 0, quantized: bool = False):
+    if quantized:  # extra per-block (bs, KVH) scale-plane operands
+        ks_ref, vs_ref, slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -189,6 +282,9 @@ def _decode_kernel(block_tables_ref, ctx_lens_ref, q_ref, k_ref, v_ref, slopes_r
             qh = q_ref[0, pl.dslice(h * g, g), :].astype(jnp.float32) * scale  # (g, d)
             kh = k_ref[0, :, h, :].astype(jnp.float32)  # (bs, d)
             vh = v_ref[0, :, h, :].astype(jnp.float32)
+            if quantized:  # fused dequant in VMEM: int8 stream * per-slot scale
+                kh = kh * ks_ref[0, :, h][:, None]
+                vh = vh * vs_ref[0, :, h][:, None]
             s = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)  # (g, bs)
             if has_alibi:
@@ -225,11 +321,12 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
     (padding) produce unspecified output.
     """
     B, H, D = q.shape
-    N, bs, KVH, _ = k_pages.shape
+    N, bs, KVH, _ = kv_pool_shape(k_pages)
     P = block_tables.shape[1]
     G = H // KVH
     scale = scale if scale is not None else D**-0.5
     has_alibi = alibi_slopes is not None
+    quantized = isinstance(k_pages, tuple)
 
     if pltpu is None:  # pallas TPU submodule absent: gather path covers interpret mode too
         sl = jnp.asarray(alibi_slopes, jnp.float32) if has_alibi else None
@@ -239,16 +336,20 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
     slopes_in = (jnp.broadcast_to(jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1), (H, 128))
                  if has_alibi else jnp.zeros((H, 128), jnp.float32))
     kernel = functools.partial(_decode_kernel, bs=bs, kvh=KVH, g=G, d=D, pages=P, scale=scale,
-                               has_alibi=has_alibi, window=int(window or 0))
+                               has_alibi=has_alibi, window=int(window or 0), quantized=quantized)
+    page_spec = pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, bs, KVH), lambda b, p, bt, cl: (bt[b, p], 0, 0))
+    in_specs = [pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)), page_spec, page_spec]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands = (q, k_pages[0], v_pages[0], k_pages[1], v_pages[1], slopes_in)
+    else:
+        operands = (q, k_pages, v_pages, slopes_in)
+    in_specs.append(pl.BlockSpec((H, 128), lambda b, p, bt, cl: (0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, P),
-        in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl: (bt[b, p], 0, 0, 0)),
-            pl.BlockSpec((H, 128), lambda b, p, bt, cl: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, D), lambda b, p, bt, cl: (b, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((KVH, G, D), jnp.float32),
@@ -262,15 +363,15 @@ def paged_attention_decode(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.nd
         out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
         interpret=interpret,
         compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
-    )(block_tables, ctx_lens, q, k_pages, v_pages, slopes_in)
+    )(block_tables, ctx_lens, *operands)
 
 
 # ------------------------------------------------------------------
 # Pallas chunked-prefill kernel
 # ------------------------------------------------------------------
-def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_ref, slopes_ref, o_ref, acc_ref,
-                    m_ref, l_ref, *, bs: int, s_q: int, kvh: int, g: int, d: int, pages: int, scale: float,
-                    has_alibi: bool = False, window: int = 0):
+def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_ref, *rest,
+                    bs: int, s_q: int, kvh: int, g: int, d: int, pages: int, scale: float,
+                    has_alibi: bool = False, window: int = 0, quantized: bool = False):
     """Grid (B, pages): stream the live pages of one sequence past a whole
     chunk of S_q query tokens with online softmax — the prefill sibling of
     ``_decode_kernel`` (reference blocked_flash over the paged pool).
@@ -278,6 +379,11 @@ def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_r
     prefill continues a partially-written context). Per-kv-head rows are
     flattened to 2D (s_q*g, ...) — see the Mosaic 3D-dot note in
     ``_decode_kernel``."""
+    if quantized:  # extra per-block (bs, KVH) scale-plane operands
+        ks_ref, vs_ref, slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
+    else:
+        ks_ref = vs_ref = None
+        slopes_ref, o_ref, acc_ref, m_ref, l_ref = rest
     b = pl.program_id(0)
     p = pl.program_id(1)
     sg = s_q * g
@@ -309,6 +415,9 @@ def _prefill_kernel(block_tables_ref, ctx_lens_ref, qpos0_ref, q_ref, k_ref, v_r
             qh = q_ref[0, :, pl.dslice(h * g, g), :].reshape(sg, d).astype(jnp.float32) * scale
             kh = k_ref[0, :, h, :].astype(jnp.float32)  # (bs, d)
             vh = v_ref[0, :, h, :].astype(jnp.float32)
+            if quantized:  # fused dequant in VMEM: int8 stream * per-slot scale
+                kh = kh * ks_ref[0, :, h][:, None]
+                vh = vh * vs_ref[0, :, h][:, None]
             s = jax.lax.dot_general(qh, kh, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)  # (sg, bs)
             if has_alibi:
@@ -352,11 +461,12 @@ def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.n
     Returns (B, S, H, D).
     """
     B, S, H, D = q.shape
-    N, bs, KVH, _ = k_pages.shape
+    N, bs, KVH, _ = kv_pool_shape(k_pages)
     P = block_tables.shape[1]
     G = H // KVH
     scale = scale if scale is not None else D**-0.5
     has_alibi = alibi_slopes is not None
+    quantized = isinstance(k_pages, tuple)
 
     # the fp32 accumulator scratch is (KVH, G, S, D) — VMEM scales linearly
     # with the chunk length, so long un-chunked prompts (engine put() prefills
@@ -371,16 +481,20 @@ def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.n
     slopes_in = (jnp.broadcast_to(jnp.asarray(alibi_slopes, jnp.float32).reshape(H, 1), (H, 128))
                  if has_alibi else jnp.zeros((H, 128), jnp.float32))
     kernel = functools.partial(_prefill_kernel, bs=bs, s_q=S, kvh=KVH, g=G, d=D, pages=P, scale=scale,
-                               has_alibi=has_alibi, window=int(window or 0))
+                               has_alibi=has_alibi, window=int(window or 0), quantized=quantized)
+    page_spec = pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl, q0: (bt[b, p], 0, 0, 0))
+    scale_spec = pl.BlockSpec((1, bs, KVH), lambda b, p, bt, cl, q0: (bt[b, p], 0, 0))
+    in_specs = [pl.BlockSpec((1, S, H, D), lambda b, p, bt, cl, q0: (b, 0, 0, 0)), page_spec, page_spec]
+    if quantized:
+        in_specs += [scale_spec, scale_spec]
+        operands = (q, k_pages[0], v_pages[0], k_pages[1], v_pages[1], slopes_in)
+    else:
+        operands = (q, k_pages, v_pages, slopes_in)
+    in_specs.append(pl.BlockSpec((H, 128), lambda b, p, bt, cl, q0: (0, 0)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(B, P),
-        in_specs=[
-            pl.BlockSpec((1, S, H, D), lambda b, p, bt, cl, q0: (b, 0, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl, q0: (bt[b, p], 0, 0, 0)),
-            pl.BlockSpec((1, bs, KVH, D), lambda b, p, bt, cl, q0: (bt[b, p], 0, 0, 0)),
-            pl.BlockSpec((H, 128), lambda b, p, bt, cl, q0: (0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, S, H, D), lambda b, p, bt, cl, q0: (b, 0, 0, 0)),
         scratch_shapes=[
             pltpu.VMEM((KVH, S * G, D), jnp.float32),
@@ -394,4 +508,4 @@ def paged_attention_prefill(q: jnp.ndarray, k_pages: jnp.ndarray, v_pages: jnp.n
         out_shape=jax.ShapeDtypeStruct((B, S, H, D), q.dtype),
         interpret=interpret,
         compiler_params=_compiler_params("parallel", "arbitrary", interpret=interpret),
-    )(block_tables, ctx_lens, qpos0, q, k_pages, v_pages, slopes_in)
+    )(block_tables, ctx_lens, qpos0, *operands)

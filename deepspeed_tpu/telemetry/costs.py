@@ -202,6 +202,7 @@ class PerfAccountant:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self.prefix_hit_tokens = 0
+        self.readmit_tokens = 0
         self.cow_bytes = 0
         self._peaks: Optional[Tuple[float, float]] = None
         self._m_flops = self._m_useful = self._m_slot = None
@@ -221,6 +222,7 @@ class PerfAccountant:
                 "temp_peak": tele.gauge("infer_hbm_temp_peak_bytes"),
                 "kv_pages": tele.gauge("kv_hbm_pages_bytes"),
                 "prefix": tele.gauge("kv_hbm_prefix_bytes"),
+                "host_spill": tele.gauge("kv_host_spill_bytes"),
                 "pressure": tele.gauge("infer_hbm_pressure"),
             }
 
@@ -351,6 +353,16 @@ class PerfAccountant:
         with self._lock:
             self.prefix_hit_tokens += int(tokens)
 
+    def note_readmit(self, tokens: int) -> None:
+        """Tokens whose KV returned from the host spill tier via h2d DMA
+        instead of a prefill re-run (docs/SERVING.md "Tiered KV economy").
+        Priced in the ledger at the prefill-class FLOP rate, like prefix
+        hits — the DMA replaced exactly that work."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.readmit_tokens += int(tokens)
+
     def note_cow(self, n_bytes: int) -> None:
         if not self.enabled:
             return
@@ -391,6 +403,7 @@ class PerfAccountant:
         out.setdefault("weights", 0)
         out.setdefault("kv_pages", 0)
         out.setdefault("prefix", 0)
+        out.setdefault("host_spill", 0)
         out.setdefault("temp_peak", 0)
         out.setdefault("pressure", 0.0)
         if self._hbm_limit:
@@ -430,6 +443,7 @@ class PerfAccountant:
             useful, slot = self.useful_tokens, self.slot_tokens
             proposed, accepted = self.spec_proposed, self.spec_accepted
             prefix_tokens, cow = self.prefix_hit_tokens, self.cow_bytes
+            readmit_tokens = self.readmit_tokens
         rejected = max(0, proposed - accepted)
         # wasted verify work: the spec programs' attributed FLOPs scale by
         # the rejected fraction of proposed tokens
@@ -443,6 +457,10 @@ class PerfAccountant:
         pre_flops = sum(c.flops * c.timed_calls for c in pre_cards)
         pre_slots = sum(c.slot_tokens for c in pre_cards)
         saved_flops = int(prefix_tokens * pre_flops / pre_slots) if pre_slots else 0
+        # re-admitted tokens are a subset of prefix hits whose KV came back
+        # over h2d DMA — without the host tier they would have re-prefetched
+        # nothing from the cache and re-run prefill
+        readmit_saved = int(readmit_tokens * pre_flops / pre_slots) if pre_slots else 0
         return {
             "useful_tokens": useful,
             "slot_tokens": slot,
@@ -453,6 +471,8 @@ class PerfAccountant:
             "spec_rejected_flops": rejected_flops,
             "prefix_hit_tokens": prefix_tokens,
             "prefix_saved_prefill_flops": saved_flops,
+            "readmit_tokens": readmit_tokens,
+            "readmit_saved_prefill_flops": readmit_saved,
             "cow_copy_bytes": cow,
         }
 
@@ -493,6 +513,7 @@ class PerfAccountant:
             self.attributed_time_s = 0.0
             self.spec_proposed = self.spec_accepted = 0
             self.prefix_hit_tokens = 0
+            self.readmit_tokens = 0
             self.cow_bytes = 0
 
     def reset(self) -> None:

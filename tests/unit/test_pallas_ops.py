@@ -661,3 +661,136 @@ class TestFlashBiasCollapsed:
         q, k, v = self._qkv()
         with pytest.raises(ValueError, match="broadcastable"):
             flash_attention(q, k, v, causal=False, bias=jnp.zeros((3, 2, 32, 32)), interpret=True)
+
+
+# ---------------- int8-quantized paged KV ----------------
+class TestPagedAttentionInt8:
+    """Fused-dequant paged attention vs the fp32 gather oracle.
+
+    Two-tier check per kernel: (a) the Pallas int8 kernel must match the
+    int8 *reference* (same codes, dequant in the oracle) to float
+    round-off — the fused dequant itself adds no error; (b) against the
+    fp32 oracle the end-to-end error is bounded by the quantizer's
+    1/254-of-amax step propagated through softmax-weighted averaging."""
+
+    def _pools(self, seed=11, B=3, KVH=2, D=16, bs=8, P=4, N=16,
+               ctx=(5, 17, 8)):
+        from deepspeed_tpu.ops.pallas.paged_attention import (make_kv_pool,
+                                                              update_kv_pages)
+        rng = np.random.RandomState(seed)
+        ctx = np.asarray(ctx, np.int32)
+        bt = np.zeros((B, P), np.int32)
+        nxt, slots, ks, vs = 1, [], [], []
+        for b in range(B):
+            nb = -(-int(ctx[b]) // bs)
+            blocks = list(range(nxt, nxt + nb))
+            nxt += nb
+            bt[b, :nb] = blocks
+            for t in range(int(ctx[b])):
+                slots.append(blocks[t // bs] * bs + t % bs)
+                ks.append(rng.randn(KVH, D))
+                vs.append(rng.randn(KVH, D))
+        kn = jnp.asarray(np.stack(ks), jnp.float32)
+        vn = jnp.asarray(np.stack(vs), jnp.float32)
+        sm = jnp.asarray(slots, jnp.int32)
+        kf, vf = update_kv_pages(jnp.zeros((N, bs, KVH, D), jnp.float32),
+                                 jnp.zeros((N, bs, KVH, D), jnp.float32), kn, vn, sm)
+        k8, v8 = update_kv_pages(make_kv_pool((N, bs, KVH, D), jnp.float32, 8),
+                                 make_kv_pool((N, bs, KVH, D), jnp.float32, 8), kn, vn, sm)
+        return rng, jnp.asarray(ctx), jnp.asarray(bt), (kf, vf), (k8, v8)
+
+    def test_quantize_roundtrip_error_bounded(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import dequantize_kv, quantize_kv
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(64, 2, 32) * 3.0, jnp.float32)
+        codes, scales = quantize_kv(x)
+        assert codes.dtype == jnp.int8 and scales.shape == (64, 2)
+        back = dequantize_kv((codes, scales))
+        # symmetric rounding: per-row error <= half a step = amax / 254
+        step = np.asarray(jnp.max(jnp.abs(x), axis=-1))[..., None] / 254.0
+        assert np.all(np.abs(np.asarray(back - x)) <= step + 1e-7)
+        # all-zero rows stay exact (scale pinned to 1.0, not 0/0)
+        z = jnp.zeros((4, 2, 32), jnp.float32)
+        np.testing.assert_array_equal(np.asarray(dequantize_kv(quantize_kv(z))), np.asarray(z))
+
+    def test_decode_int8_matches_quantized_ref(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (paged_attention_decode,
+                                                              paged_attention_ref)
+        rng, ctx, bt, _, (k8, v8) = self._pools()
+        q = jnp.asarray(rng.randn(3, 4, 16), jnp.float32)
+        o_ref = paged_attention_ref(q[:, None], k8, v8, bt, ctx, (ctx - 1)[:, None])[:, 0]
+        o_pal = paged_attention_decode(q, k8, v8, bt, ctx, interpret=True)
+        np.testing.assert_allclose(np.asarray(o_pal), np.asarray(o_ref), atol=2e-6, rtol=2e-6)
+
+    def test_decode_int8_error_vs_fp32_oracle_bounded(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (paged_attention_decode,
+                                                              paged_attention_ref)
+        rng, ctx, bt, (kf, vf), (k8, v8) = self._pools()
+        q = jnp.asarray(rng.randn(3, 4, 16), jnp.float32)
+        o_fp = paged_attention_ref(q[:, None], kf, vf, bt, ctx, (ctx - 1)[:, None])[:, 0]
+        o_q = paged_attention_decode(q, k8, v8, bt, ctx, interpret=True)
+        err = np.abs(np.asarray(o_q) - np.asarray(o_fp))
+        # V error: one quant step of the ~N(0,1) values; K error perturbs
+        # softmax weights by ~scale*|q|/254 per logit — both well under 5e-2
+        assert float(err.max()) < 5e-2, f"int8 decode error {err.max():.3e}"
+
+    def test_prefill_int8_matches_quantized_ref_and_fp32_bound(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (paged_attention_prefill,
+                                                              paged_attention_ref)
+        rng, ctx0, bt, (kf, vf), (k8, v8) = self._pools(ctx=(8, 24, 16))
+        B, S, H, D = 3, 8, 4, 16
+        ctx = ctx0 + S  # S new tokens atop each context
+        # extend block tables to cover the appended tokens (pages already
+        # big enough at P=4 for ctx<=32); positions are the last S slots
+        pos = (ctx[:, None] - S + jnp.arange(S)[None, :]).astype(jnp.int32)
+        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        bt2 = np.asarray(bt).copy()
+        nxt = int(np.asarray(bt).max()) + 1
+        for b in range(B):
+            nb0, nb1 = -(-int(ctx0[b]) // 8), -(-int(ctx[b]) // 8)
+            for p in range(nb0, nb1):
+                bt2[b, p] = nxt
+                nxt += 1
+        bt2 = jnp.asarray(bt2)
+        # write the S new tokens into both pools so context is complete
+        from deepspeed_tpu.ops.pallas.paged_attention import update_kv_pages
+        slots, ks, vs = [], [], []
+        for b in range(B):
+            for i, t in enumerate(range(int(ctx0[b]), int(ctx[b]))):
+                slots.append(int(bt2[b, t // 8]) * 8 + t % 8)
+                ks.append(rng.randn(2, D))
+                vs.append(rng.randn(2, D))
+        kn, vn = jnp.asarray(np.stack(ks), jnp.float32), jnp.asarray(np.stack(vs), jnp.float32)
+        sm = jnp.asarray(slots, jnp.int32)
+        kf, vf = update_kv_pages(kf, vf, kn, vn, sm)
+        k8, v8 = update_kv_pages(k8, v8, kn, vn, sm)
+
+        o_qref = paged_attention_ref(q, k8, v8, bt2, ctx, pos)
+        o_pal = paged_attention_prefill(q, k8, v8, bt2, ctx, pos, interpret=True)
+        np.testing.assert_allclose(np.asarray(o_pal), np.asarray(o_qref), atol=2e-6, rtol=2e-6)
+        o_fp = paged_attention_ref(q, kf, vf, bt2, ctx, pos)
+        err = np.abs(np.asarray(o_pal) - np.asarray(o_fp))
+        assert float(err.max()) < 5e-2, f"int8 prefill error {err.max():.3e}"
+
+    def test_mixed_routes_quantized_pools(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (paged_attention_mixed,
+                                                              paged_attention_ref)
+        rng, ctx, bt, _, (k8, v8) = self._pools()
+        T, H, D = 3, 4, 16
+        q = jnp.asarray(rng.randn(T, H, D), jnp.float32)
+        o_mix = paged_attention_mixed(q, k8, v8, bt, ctx, (ctx - 1), n_dec=T, chunk=0)
+        o_ref = paged_attention_ref(q[:, None], k8, v8, bt, ctx, (ctx - 1)[:, None])[:, 0]
+        np.testing.assert_allclose(np.asarray(o_mix), np.asarray(o_ref), atol=2e-6, rtol=2e-6)
+
+    def test_layer_helpers_roundtrip(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import (kv_layer, kv_pool_is_quantized,
+                                                              kv_pool_shape, kv_set_layer,
+                                                              make_kv_pool, quantize_kv)
+        pool = make_kv_pool((2, 4, 3, 2, 8), jnp.float32, 8)
+        assert kv_pool_is_quantized(pool) and not kv_pool_is_quantized(jnp.zeros(3))
+        assert kv_pool_shape(pool) == (2, 4, 3, 2, 8)
+        x = jnp.asarray(np.random.RandomState(3).randn(4, 3, 2, 8), jnp.float32)
+        pool = kv_set_layer(pool, 1, quantize_kv(x))
+        got = kv_layer(pool, 1)
+        np.testing.assert_array_equal(np.asarray(got[0]), np.asarray(quantize_kv(x)[0]))
+        assert kv_layer(pool, 0)[0].shape == (4, 3, 2, 8)

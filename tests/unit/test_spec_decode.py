@@ -159,6 +159,32 @@ class TestSelectCommitted:
         target = np.asarray(jax.nn.softmax(logits[0, 0]))
         np.testing.assert_allclose(freq, target, atol=0.03)
 
+    def test_rejection_sampling_preserves_target_under_quantized_logits(self):
+        # int8 KV pools shift the verify logits onto the quantizer's grid;
+        # the rejection-sampling identity must hold for THOSE logits — the
+        # committed-token law is the softmax of the quantized target, so
+        # acceptance stays distribution-preserving end to end (ISSUE:
+        # spec decode over int8 pools)
+        from deepspeed_tpu.ops.pallas.paged_attention import dequantize_kv, quantize_kv
+        V = 4
+        base = jnp.tile(jnp.asarray([[[1.0, 0.5, 0.0, -0.5]]]), (1, 2, 1))
+        logits = dequantize_kv(quantize_kv(base))
+        # the grid genuinely moved the target (else this re-tests the fp32 case)
+        assert float(jnp.max(jnp.abs(logits - base))) > 1e-4
+        drafts = jnp.asarray([[2]], jnp.int32)
+        n_draft = jnp.asarray([1], jnp.int32)
+
+        def first_token(key):
+            committed, _ = select_committed(logits, drafts, n_draft, key,
+                                            do_sample=True, temperature=1.0)
+            return committed[0, 0]
+
+        n = 4096
+        toks = jax.jit(jax.vmap(first_token))(jax.random.split(jax.random.PRNGKey(1), n))
+        freq = np.bincount(np.asarray(toks), minlength=V) / n
+        target = np.asarray(jax.nn.softmax(logits[0, 0]))
+        np.testing.assert_allclose(freq, target, atol=0.03)
+
 
 @pytest.mark.fast
 class TestRollback:

@@ -10,8 +10,8 @@
 #   so a dead tunnel costs seconds, not an hour of wedged timeouts
 #   with every later artifact silently missing;
 # - chip windows die early: rungs with ZERO hardware evidence (attn,
-#   attn_d64, longctx, serve_sla, serve_prefix, serve_spec, int8/int4
-#   A/B — never measured on a real chip) run FIRST; re-measures of
+#   attn_d64, longctx, serve_sla, serve_prefix, serve_spec, serve_kvtier,
+#   int8/int4 A/B — never measured on a real chip) run FIRST; re-measures of
 #   known-good numbers (full ladder, train sweep) spend whatever window
 #   is left.
 cd "$(dirname "$0")/.." || exit 1
@@ -41,9 +41,9 @@ fi
 
 # ---- phase A: never-measured rungs (zero hardware evidence) ----
 i=0
-for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec; do
+for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec serve_kvtier; do
     i=$((i+1))
-    note "A$i/6 bench rung $rung (never measured on-chip)"
+    note "A$i/7 bench rung $rung (never measured on-chip)"
     DS_BENCH_EXTRA=0 DS_BENCH_RUNG=$rung timeout 1800 python bench.py >> "$LOG" 2>&1
     note "$rung rc=$?"
     probe

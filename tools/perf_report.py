@@ -119,12 +119,15 @@ def render_snapshot(rung, snap):
     if ledger.get("prefix_hit_tokens"):
         out.append(f"  prefix cache: {int(ledger.get('prefix_hit_tokens', 0))} tokens reused "
                    f"(~{_num(ledger.get('prefix_saved_prefill_flops', 0))}F prefill saved)")
+    if ledger.get("readmit_tokens"):
+        out.append(f"  kv readmit: {int(ledger.get('readmit_tokens', 0))} tokens over h2d "
+                   f"(~{_num(ledger.get('readmit_saved_prefill_flops', 0))}F prefill saved)")
     if ledger.get("cow_copy_bytes"):
         out.append(f"  cow copies: {_num(ledger.get('cow_copy_bytes', 0), 'B')}")
 
     hbm = snap.get("hbm") or {}
     out.append("hbm pools:")
-    for k in ("weights", "kv_pages", "prefix", "temp_peak"):
+    for k in ("weights", "kv_pages", "prefix", "temp_peak", "host_spill"):
         out.append(f"  {k:<10} {_num(hbm.get(k, 0), 'B')}")
     if hbm.get("limit"):
         out.append(f"  pressure   {100.0 * float(hbm.get('pressure', 0.0)):.1f}% "
