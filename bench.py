@@ -1006,6 +1006,14 @@ def main():
     # window; an explicit DS_TPU_PERF_ACCOUNT in the env still wins
     os.environ.setdefault("DS_TPU_PERF_ACCOUNT", "2")
     n_dev, platform = _probe_backend()
+    # long hardware rungs are scrapable mid-run when DS_TPU_OPS_PORT is
+    # set (hw_session.sh's serve smoke curls /healthz and /perf); unset,
+    # this is one int compare
+    try:
+        from deepspeed_tpu.telemetry import maybe_start_ops_server
+        maybe_start_ops_server()
+    except Exception as e:
+        print(f"[bench] ops plane unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
     import jax
 

@@ -226,6 +226,30 @@ declare("DS_TPU_PEAK_GBPS", "0", "float",
         "Declared peak HBM GB/s per chip for roofline classification "
         "(0 = auto-detect from the device kind).",
         "telemetry/costs.py")
+declare("DS_TPU_OPS_PORT", "0", "int",
+        "Introspection server port (/metrics, /healthz, /requests, /perf, "
+        "/flight, /varz). 0 (the default) starts nothing: zero threads, "
+        "zero sockets.",
+        "telemetry/ops_plane.py")
+declare("DS_TPU_FLIGHT_DIR", None, "str",
+        "If set, attach the flight recorder: every health alert snapshots "
+        "the black box (events, spans, metrics, perf, residency, knobs) "
+        "into a bounded capture ring under this directory.",
+        "telemetry/flight.py")
+declare("DS_TPU_FLIGHT_MAX", "8", "int",
+        "Flight-recorder ring size: oldest on-disk captures are evicted "
+        "beyond this many.",
+        "telemetry/flight.py")
+declare("DS_TPU_FLIGHT_PROFILE_S", "0", "float",
+        "If >0, each flight capture also records a jax.profiler trace of "
+        "this many seconds following the anomaly (opt-in: tracing is not "
+        "free).",
+        "telemetry/flight.py")
+declare("DS_TPU_STRAGGLER_X", "4", "float",
+        "Straggler detector threshold: flag a rank whose pooled "
+        "collective-wait p50 exceeds this multiple of the cross-rank "
+        "median p50.",
+        "telemetry/health.py")
 
 # Ops / kernels
 declare("DS_TPU_OP_", None, "str",

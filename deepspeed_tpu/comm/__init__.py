@@ -8,7 +8,8 @@ names via the ``collectives`` submodule.
 
 from .comm import (all_gather_into_tensor, all_gather_object, all_reduce, all_to_all_single, barrier, broadcast,
                    broadcast_object_list,
-                   comms_logger, configure, destroy_process_group, get_all_ranks_from_group, get_local_rank, get_rank,
+                   comms_logger, configure, destroy_process_group, dump_telemetry_snapshot, get_all_ranks_from_group,
+                   get_local_rank, get_rank,
                    get_world_group, get_world_size, init_distributed, is_initialized, log_summary, monitored_barrier,
                    new_group, reduce_scatter_tensor)
 from .reduce_op import ReduceOp
@@ -19,4 +20,5 @@ __all__ = [
     "all_gather_into_tensor", "reduce_scatter_tensor", "all_to_all_single", "broadcast", "all_gather_object",
     "log_summary", "configure", "comms_logger", "ReduceOp", "collectives", "new_group", "get_world_group",
     "monitored_barrier", "get_all_ranks_from_group", "destroy_process_group", "broadcast_object_list",
+    "dump_telemetry_snapshot",
 ]

@@ -89,6 +89,15 @@ def configure(config=None, enabled=None, prof_all=None, prof_ops=None, verbose=N
             setattr(comms_logger, k, v)
 
 
+def dump_telemetry_snapshot(dir_path: str) -> str:
+    """Write this rank's stamped metrics snapshot (including the per-op
+    ``comm_latency_seconds`` histograms the straggler analysis consumes)
+    to ``<dir>/telemetry-rank<k>.json``; call on every rank, then merge
+    with ``tools/telemetry_merge.py``. Returns the written path."""
+    from ..telemetry.agg import write_rank_snapshot
+    return write_rank_snapshot(dir_path)
+
+
 def get_rank(group=None) -> int:
     return jax.process_index()
 

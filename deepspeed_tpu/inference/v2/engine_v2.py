@@ -30,8 +30,10 @@ from ...telemetry import get_registry as get_telemetry_registry
 from ...telemetry import span as telemetry_span
 from ...telemetry.costs import get_perf_accountant
 from ...telemetry.events import get_event_log
+from ...telemetry.flight import maybe_attach_flight_recorder
 from ...telemetry.health import (HBMPressureDetector, QueueStallDetector,
                                  SLOBurnRateDetector, get_health_monitor)
+from ...telemetry.ops_plane import maybe_start_ops_server
 from ...utils.logging import log_dist, logger
 from ...ops.pallas.paged_attention import make_kv_pool
 from .model_runner import (make_burst_fn, make_fused_step_fn, make_spec_verify_fn,
@@ -170,6 +172,7 @@ class InferenceEngineV2:
             bytes_per_block = 2 * cfg.n_layers * smc.kv_block_size * cfg.kv_heads * slot_head_bytes
             n_blocks = max(8, int(smc.memory_gb * (1 << 30) // bytes_per_block))
         self.state = DSStateManager(smc, n_blocks, enable_prefix_cache=config.enable_prefix_cache)
+        self._n_kv_blocks = int(n_blocks)
         self.scheduler = RaggedBatchScheduler(self.state, max_batch_tokens=smc.max_ragged_batch_size,
                                               max_sequences=smc.max_ragged_sequence_count)
 
@@ -204,6 +207,15 @@ class InferenceEngineV2:
         self._m_cow_bytes = tele.counter("kv_cow_bytes_total")
         # expected RMS dequant error of the int8 KV pool (0.0 when off)
         self._m_quant_err = tele.gauge("kv_quant_dequant_error")
+        # live ops plane (docs/OBSERVABILITY.md "Ops plane & flight
+        # recorder"): introspection server when DS_TPU_OPS_PORT is set,
+        # black-box flight recorder when DS_TPU_FLIGHT_DIR is set — both
+        # default off, and the disabled path is one int compare each.
+        maybe_start_ops_server()
+        _rec = maybe_attach_flight_recorder(self._health)
+        if _rec is not None:
+            _rec.register_provider("residency", self._residency_summary)
+            _rec.register_provider("jit_cache", self._jit_cache_summary)
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -1077,6 +1089,29 @@ class InferenceEngineV2:
         finally:
             self._sampling = None
             self._update_hbm_gauges()
+
+    def _residency_summary(self) -> Dict:
+        """Allocator / prefix-cache / host-tier residency — the flight
+        recorder's view of where every KV block lives at capture time."""
+        pc = self.state.prefix_cache
+        return {
+            "kv_blocks_total": self._n_kv_blocks,
+            "kv_blocks_free": int(self.state.free_blocks),
+            "block_bytes": int(self._block_bytes),
+            "kv_quant_bits": int(self._kv_quant_bits),
+            "prefix_cached_blocks": int(pc.cached_blocks) if pc is not None else 0,
+            "host_tier_bytes": int(pc.host_tier_bytes) if pc is not None else 0,
+        }
+
+    def _jit_cache_summary(self) -> Dict:
+        """JitAuditor view for flight captures: total compiles and any
+        steady-state recompiles (the recompile-storm signal)."""
+        a = self.jit_auditor
+        if a is None:
+            return {"enabled": False}
+        return {"enabled": True, "compiles": int(a.compiles),
+                "steady": bool(a.steady),
+                "steady_recompiles": int(a.steady_recompiles)}
 
     def _update_hbm_gauges(self) -> None:
         """Refresh the per-pool HBM gauges (weights, paged KV, prefix-held

@@ -79,6 +79,11 @@ class RequestStat:
 def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List[RequestStat]:
     """Drive ``engine`` with ``spec``'s arrival process; returns per-request
     stats. Greedy decoding (the SLA story is scheduling, not sampling)."""
+    # a live SLA run is exactly what an operator wants to scrape: make the
+    # introspection endpoints available for its duration (no-op when the
+    # port knob is unset, or when the engine already started the server)
+    from ...telemetry.ops_plane import maybe_start_ops_server
+    maybe_start_ops_server()
     rng = np.random.default_rng(spec.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / spec.arrival_rate, spec.n_requests))
     lo, hi = spec.prompt_len_range

@@ -282,6 +282,13 @@ class DeepSpeedEngine:
         self.health = get_health_monitor()
         self.health.ensure_detector(NonFiniteLossDetector())
         self.health.ensure_detector(GradNormSpikeDetector())
+        # live ops plane: introspection server (DS_TPU_OPS_PORT) and
+        # flight recorder (DS_TPU_FLIGHT_DIR) — a NaN loss mid-run leaves
+        # a black-box capture behind. Both default off.
+        from ..telemetry.ops_plane import maybe_start_ops_server
+        from ..telemetry.flight import maybe_attach_flight_recorder
+        maybe_start_ops_server()
+        maybe_attach_flight_recorder(self.health)
 
         # legacy curriculum learning (reference engine.py:1821-1833): the
         # scheduler's difficulty is a sequence length; forward() truncates

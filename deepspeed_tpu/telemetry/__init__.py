@@ -18,8 +18,14 @@ from .health import (Alert, CallbackAlertSink, Detector,
                      GradNormSpikeDetector, HBMPressureDetector,
                      HealthMonitor, JsonlAlertSink, LoggerAlertSink,
                      NonFiniteLossDetector, QueueStallDetector,
-                     SLOBurnRateDetector, get_health_monitor)
+                     SLOBurnRateDetector, StragglerDetector,
+                     get_health_monitor)
 from .costs import CostCard, PerfAccountant, get_perf_accountant, resolve_peaks
+from .agg import (detect_stragglers, histogram_quantile, merge_snapshot_files,
+                  merge_snapshots, rank_stamp, write_rank_snapshot)
+from .flight import (FlightRecorder, get_flight_recorder,
+                     maybe_attach_flight_recorder, resolved_knobs)
+from .ops_plane import OpsServer, get_ops_server, maybe_start_ops_server
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
@@ -29,9 +35,13 @@ __all__ = [
     "latency_summary", "lifecycle_signature", "validate_timeline",
     "Alert", "Detector", "HealthMonitor", "get_health_monitor",
     "NonFiniteLossDetector", "GradNormSpikeDetector", "QueueStallDetector",
-    "SLOBurnRateDetector", "HBMPressureDetector", "LoggerAlertSink",
-    "JsonlAlertSink", "CallbackAlertSink",
+    "SLOBurnRateDetector", "HBMPressureDetector", "StragglerDetector",
+    "LoggerAlertSink", "JsonlAlertSink", "CallbackAlertSink",
     "CostCard", "PerfAccountant", "get_perf_accountant", "resolve_peaks",
+    "rank_stamp", "write_rank_snapshot", "merge_snapshots",
+    "merge_snapshot_files", "histogram_quantile", "detect_stragglers",
+    "FlightRecorder", "get_flight_recorder", "maybe_attach_flight_recorder",
+    "resolved_knobs", "OpsServer", "get_ops_server", "maybe_start_ops_server",
 ]
 
 

@@ -29,6 +29,7 @@ Env knobs: ``DS_TPU_EVENT_RING`` sizes the ring (default 65536),
 disables emission entirely.
 """
 
+import atexit
 import json
 import queue
 import threading
@@ -69,6 +70,7 @@ class EventLog:
         self._thread: Optional[threading.Thread] = None
         self._sink_path: Optional[str] = None
         self._sink_queue = int(sink_queue)
+        self._atexit_registered = False
         if sink_path:
             self.open_sink(sink_path)
 
@@ -120,6 +122,14 @@ class EventLog:
         self.close_sink()
         self._sink_path = str(path)
         self._queue = queue.Queue(maxsize=self._sink_queue)
+        if not self._atexit_registered:
+            # short-lived CLI runs (bench, hw_smoke) exit before the daemon
+            # drain thread empties its queue — flush+join at interpreter
+            # shutdown so the last events reach disk. close_sink is
+            # idempotent, so one registration covers any number of
+            # open/close cycles.
+            atexit.register(self.close_sink)
+            self._atexit_registered = True
         self._thread = threading.Thread(
             target=self._drain, name="ds-tpu-event-log", daemon=True)
         self._thread.start()

@@ -317,6 +317,49 @@ class HBMPressureDetector(Detector):
         return None
 
 
+class StragglerDetector(Detector):
+    """Cross-rank collective-wait skew (the TP-mesh hang precursor).
+
+    Consumes per-rank metric snapshots — each carrying the existing
+    ``comm_latency_seconds{op=...}`` histograms — pools each rank's
+    collective-wait distribution (``agg.comm_wait_profile``) and alerts
+    when any rank's p50 exceeds ``ratio`` × the cross-rank median p50
+    (``DS_TPU_STRAGGLER_X``, default 4). Re-arms when no rank diverges.
+    Driven from wherever per-rank snapshots meet: the merge CLI, the
+    forked dist tier, or a controller process feeding
+    ``HealthMonitor.observe_rank_snapshots``.
+    """
+
+    name = "comm_straggler"
+    severity = "warning"
+
+    def __init__(self, ratio: Optional[float] = None, min_count: int = 8, **kw):
+        super().__init__(**kw)
+        self.ratio = float(ratio if ratio is not None
+                           else knobs.get_float("DS_TPU_STRAGGLER_X"))
+        self.min_count = int(min_count)
+        self.last_report: Dict = {}
+
+    def observe_snapshots(self, snaps) -> Optional[Alert]:
+        from .agg import detect_stragglers
+        report = detect_stragglers(snaps, ratio=self.ratio,
+                                   min_count=self.min_count)
+        self.last_report = report
+        stragglers = report["stragglers"]
+        if not stragglers:
+            self._rearm()
+            return None
+        worst = max(stragglers, key=lambda s: s["ratio"])
+        return self._maybe_alert(
+            f"rank {worst['rank']} collective-wait p50 "
+            f"{worst['p50'] * 1e3:.1f}ms is {worst['ratio']:.1f}x the "
+            f"cross-rank median ({report['median_p50'] * 1e3:.1f}ms, "
+            f"threshold {self.ratio:g}x)",
+            ranks=[s["rank"] for s in stragglers],
+            p50_by_rank=report["p50_by_rank"],
+            median_p50=report["median_p50"])
+
+
 # ---------------------------------------------------------------- monitor
 
 class HealthMonitor:
@@ -378,6 +421,13 @@ class HealthMonitor:
         d = self._detectors.get(HBMPressureDetector.name)
         if d is not None:
             self._dispatch(d.observe(float(fraction), **attrs))
+
+    def observe_rank_snapshots(self, snaps) -> None:
+        """Feed merged-view inputs (a list of per-rank snapshot dicts)
+        into the cross-rank detectors; registers the straggler detector
+        on first use so callers need no wiring of their own."""
+        d = self.ensure_detector(StragglerDetector())
+        self._dispatch(d.observe_snapshots(snaps))
 
     def on_event(self, ts, kind, uid, attrs) -> None:
         """EventLog listener: streams lifecycle events into detectors.
@@ -469,4 +519,6 @@ def get_health_monitor() -> HealthMonitor:
             _MONITOR.add_sink(JsonlAlertSink(path))
         from .events import get_event_log
         get_event_log().add_listener(_MONITOR.on_event)
+        from .flight import maybe_attach_flight_recorder
+        maybe_attach_flight_recorder(_MONITOR)  # no-op without DS_TPU_FLIGHT_DIR
     return _MONITOR

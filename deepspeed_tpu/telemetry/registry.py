@@ -41,11 +41,23 @@ def _label_key(labels: Dict[str, str]) -> LabelKey:
     return tuple(sorted((k, str(v)) for k, v in labels.items()))
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and newline (in that order — backslash first so the others'
+    escapes survive)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping: only backslash and newline per the format."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(labels: LabelKey, extra: Optional[Tuple[Tuple[str, str], ...]] = None) -> str:
     items = tuple(labels) + (extra or ())
     if not items:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in items) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in items) + "}"
 
 
 def _fmt_value(v: float) -> str:
@@ -136,7 +148,12 @@ class MetricsRegistry:
         self._metrics: Dict[Tuple[str, LabelKey], object] = {}
         self._kinds: Dict[str, str] = {}
         self._buckets: Dict[str, Tuple[float, ...]] = {}
+        self._help: Dict[str, str] = {}
         self._lock = threading.Lock()
+
+    def describe(self, name: str, text: str) -> None:
+        """Attach HELP text to a metric family (rendered on /metrics)."""
+        self._help[name] = str(text)
 
     # ---------------------------------------------------------- creation
     def _get(self, cls, name: str, labels: LabelKey, buckets=None):
@@ -221,18 +238,23 @@ class MetricsRegistry:
                     "buckets": {("+Inf" if le == float("inf") else format(le, "g")): c
                                 for le, c in m.cumulative()},
                 }
+        from . import agg  # lazy: agg touches jax for the rank stamp
         return {"ts_unix": time.time(), "enabled": self.enabled,
+                "rank": agg.rank_stamp(),
                 "counters": counters, "gauges": gauges, "histograms": histograms}
 
     def render_prometheus(self) -> str:
-        """Prometheus text exposition. Families sorted by name; one # TYPE
-        line per family; series are unique by construction (dict-keyed)."""
+        """Prometheus text exposition. Families sorted by name; one # HELP
+        and one # TYPE line per family (exposition-format order); label
+        values escaped; series are unique by construction (dict-keyed)."""
         by_family: Dict[str, list] = {}
         for (name, labels), m in self._metrics.items():
             by_family.setdefault(name, []).append((labels, m))
         lines = []
         for name in sorted(by_family):
             kind = self._kinds[name]
+            help_text = self._help.get(name, "see docs/OBSERVABILITY.md")
+            lines.append(f"# HELP {name} {_escape_help(help_text)}")
             lines.append(f"# TYPE {name} {kind}")
             for labels, m in sorted(by_family[name], key=lambda x: x[0]):
                 if kind == "histogram":

@@ -218,12 +218,21 @@ def test_render_prometheus_parses_clean():
     name_re = re.compile(r"^[a-z_][a-z0-9_]*$")
     seen = set()
     types = {}
+    helps = set()
     for line in reg.render_prometheus().splitlines():
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ")
             assert name_re.match(name), line
             assert name not in types, f"duplicate TYPE line: {line}"
+            assert name in helps, f"TYPE without preceding HELP: {line}"
             types[name] = kind
+            continue
+        if line.startswith("# HELP "):
+            name = line.split(" ")[2]
+            assert name_re.match(name), line
+            helps.add(name)
+            continue
+        if line.startswith("#"):  # other comments: legal, ignored
             continue
         series, value = line.rsplit(" ", 1)
         float(value)  # every sample value parses
@@ -235,3 +244,48 @@ def test_render_prometheus_parses_clean():
         seen.add(series)
     assert types == {"train_steps_total": "counter", "comm_bytes_total": "counter",
                      "kv_block_occupancy": "gauge", "infer_ttft_seconds": "histogram"}
+
+
+def test_ops_plane_overhead_within_three_percent():
+    """With DS_TPU_OPS_PORT set the introspection server costs nothing at
+    steady state (a daemon thread blocked in accept()); the only work it
+    ever adds is handling a scrape. Measured by decomposition — per-scrape
+    /metrics render cost (on a registry populated like a live serving
+    process) amortized over the scrape interval — because scrapes recur
+    per interval, not per serving step. The bound assumes a pathological
+    10 scrapes/s (real scrapers poll at >=1s): even then the handler must
+    steal <3% of wall time from serving."""
+    import time
+
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    from deepspeed_tpu.telemetry.ops_plane import OpsPlane
+
+    reg = MetricsRegistry()
+    for i in range(64):  # the series mix a serving engine accumulates
+        reg.counter("infer_requests_total", model=f"m{i % 4}").inc(i)
+        reg.gauge("kv_block_occupancy", pool=f"p{i % 8}").set(i / 64)
+        reg.histogram("infer_ttft_seconds", buckets=(0.01, 0.1, 1.0),
+                      model=f"m{i % 4}").observe(0.02 * (i % 5 + 1))
+
+    plane = OpsPlane()
+    import deepspeed_tpu.telemetry.registry as registry_mod
+    orig = registry_mod.get_registry
+    registry_mod.get_registry = lambda: reg
+    try:
+        n_scrape = 50
+
+        def scrape_cost():
+            t0 = time.perf_counter()
+            for _ in range(n_scrape):
+                status, _, body = plane.handle("GET", "/metrics")
+                assert status == 200 and body
+            return (time.perf_counter() - t0) / n_scrape
+
+        scrape_cost()  # warm
+        scrape = min(scrape_cost() for _ in range(5))
+        scrape_hz = 10.0  # pathological: prod scrapers poll at >= 1s
+        assert scrape * scrape_hz <= 0.03, \
+            f"/metrics scrape costs {scrape * 1e6:.1f}us; at {scrape_hz:g}/s " \
+            f"that is {scrape * scrape_hz:.1%} of wall time (>3%)"
+    finally:
+        registry_mod.get_registry = orig

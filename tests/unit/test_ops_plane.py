@@ -1,0 +1,505 @@
+"""Live ops plane, flight recorder, and rank-aware aggregation.
+
+The acceptance bar (docs/OBSERVABILITY.md "Ops plane & flight
+recorder"): an ops server on an ephemeral port answers every endpoint
+with valid JSON / Prometheus text of bounded size against a live fused
+SLA run; with the port knob unset zero threads start; an injected
+NaN-loss and an injected queue-stall alert each produce exactly ONE
+flight capture whose manifest carries the event tail, metrics, perf
+snapshot, residency and resolved knobs, and the on-disk ring never
+exceeds its bound; two forked ranks' snapshots merge into summed
+counters / merged histograms and an artificially slow rank trips the
+StragglerDetector on exactly that rank.
+"""
+
+import dataclasses
+import json
+import os
+import re
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2, LoadSpec,
+                                        RaggedBatchConfig, run_load)
+from deepspeed_tpu.telemetry import (CallbackAlertSink, EventLog, FlightRecorder,
+                                     HealthMonitor, MetricsRegistry,
+                                     NonFiniteLossDetector, OpsServer,
+                                     QueueStallDetector, StragglerDetector,
+                                     detect_stragglers, get_event_log,
+                                     get_health_monitor, histogram_quantile,
+                                     merge_snapshots)
+from deepspeed_tpu.telemetry.ops_plane import (MAX_BODY_BYTES,
+                                               maybe_start_ops_server)
+from dist_utils import run_distributed
+from test_inference_v2 import v2_setup  # noqa: F401  (tests/unit is on sys.path)
+
+N_REQ = 32
+SPEC = LoadSpec(n_requests=N_REQ, arrival_rate=1e9, prompt_len_range=(4, 8),
+                max_new_tokens=4, vocab_size=128, seed=7)
+
+
+def _mk_engine(v2_setup, fused=True):
+    model, params, cfg = v2_setup
+    smc = RaggedBatchConfig(kv_block_size=8, max_context=64, num_kv_blocks=96)
+    return InferenceEngineV2(model, params,
+                             dataclasses.replace(cfg, state_manager=smc, fused_step=fused))
+
+
+def _get(srv, path):
+    """(status, content_type, body_bytes) for one GET, errors included."""
+    try:
+        r = urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}", timeout=10)
+        return r.status, r.headers.get("Content-Type", ""), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.headers.get("Content-Type", ""), e.read()
+
+
+@pytest.fixture(scope="module")
+def live_server(v2_setup):
+    """Ephemeral-port ops server + one fused 32-request SLA run whose
+    telemetry the endpoints then expose."""
+    srv = OpsServer(port=0).start()
+    eng = _mk_engine(v2_setup, fused=True)
+    log = get_event_log()
+    log.clear()
+    stats = run_load(eng, SPEC)
+    yield srv, stats
+    srv.stop()
+    log.clear()
+    get_health_monitor().reset()
+
+
+class TestOpsServerLive:
+
+    def test_metrics_prometheus(self, live_server):
+        srv, _ = live_server
+        status, ctype, body = _get(srv, "/metrics")
+        assert status == 200 and ctype.startswith("text/plain")
+        assert len(body) < MAX_BODY_BYTES
+        text = body.decode()
+        assert "# TYPE infer_requests_total counter" in text
+        assert "# HELP infer_requests_total " in text
+        # every sample line parses: name{labels} value
+        for line in text.splitlines():
+            if line.startswith("#"):
+                continue
+            _, value = line.rsplit(" ", 1)
+            float(value)
+
+    def test_healthz_status_tracks_monitor(self, live_server):
+        srv, _ = live_server
+        status, _, body = _get(srv, "/healthz")
+        payload = json.loads(body)
+        mon = get_health_monitor()
+        # the tiny CPU run may trip slo_burn: the contract is coherence,
+        # and the 503 mapping any probe/load-balancer consumes
+        assert status == (200 if payload["healthy"] else 503)
+        assert payload["healthy"] == mon.healthy
+        assert payload["status"] in ("ok", "alerting")
+        assert "queue_stall" in payload["detectors"]
+        assert isinstance(payload["alerts"], list)
+        assert payload["rank"]["process_count"] >= 1
+
+    def test_requests_lists_every_uid(self, live_server):
+        srv, _ = live_server
+        status, _, body = _get(srv, "/requests")
+        assert status == 200 and len(body) < MAX_BODY_BYTES
+        payload = json.loads(body)
+        assert payload["n_tracked"] == N_REQ
+        rows = {r["uid"]: r for r in payload["requests"]}
+        assert set(rows) == set(range(N_REQ))
+        for r in rows.values():
+            assert r["state"] == "finish"
+            assert r["metrics"]["n_new"] == SPEC.max_new_tokens
+        assert payload["summary"]["n_complete"] == N_REQ
+
+    def test_request_detail_and_errors(self, live_server):
+        srv, stats = live_server
+        status, _, body = _get(srv, "/requests/0")
+        assert status == 200
+        payload = json.loads(body)
+        tl = payload["timelines"][-1]
+        assert [e["kind"] for e in tl["events"]][0] == "enqueue"
+        assert tl["metrics"]["ttft_s"] == pytest.approx(stats[0].ttft, abs=1e-9)
+        assert _get(srv, "/requests/999999")[0] == 404
+        assert _get(srv, "/requests/abc")[0] == 400
+
+    def test_perf_snapshot(self, live_server):
+        srv, _ = live_server
+        status, _, body = _get(srv, "/perf")
+        assert status == 200 and len(body) < MAX_BODY_BYTES
+        payload = json.loads(body)
+        for key in ("mode", "cards", "ledger", "hbm", "rank"):
+            assert key in payload, key
+
+    def test_varz_resolved_knobs(self, live_server):
+        srv, _ = live_server
+        status, _, body = _get(srv, "/varz")
+        assert status == 200 and len(body) < MAX_BODY_BYTES
+        knobs_out = json.loads(body)["knobs"]
+        assert knobs_out["DS_TPU_OPS_PORT"]["default"] == "0"
+        for row in knobs_out.values():
+            assert {"value", "default", "kind", "set", "owner"} <= set(row)
+
+    def test_flight_unconfigured_and_404(self, live_server):
+        srv, _ = live_server
+        status, _, body = _get(srv, "/flight")
+        assert status == 200
+        payload = json.loads(body)
+        if not payload["configured"]:
+            assert payload["captures"] == []
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/flight/capture", data=b"{}",
+                method="POST")
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 409
+        assert _get(srv, "/flight/nope")[0] == 404
+        assert _get(srv, "/nonsense")[0] == 404
+
+    def test_concurrent_scrapes(self, live_server):
+        """The endpoints answer concurrently (ThreadingHTTPServer), the
+        way a scraper + a human + a probe would hit a live engine."""
+        srv, _ = live_server
+        paths = ("/metrics", "/healthz", "/requests", "/perf", "/varz") * 4
+        results = [None] * len(paths)
+
+        def fetch(i, p):
+            results[i] = _get(srv, p)[0]
+
+        threads = [threading.Thread(target=fetch, args=(i, p))
+                   for i, p in enumerate(paths)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert all(r in (200, 503) for r in results), results
+
+
+class TestOpsGating:
+
+    def test_port_unset_starts_nothing(self, monkeypatch):
+        # (the module-scoped test server above stays up — the contract is
+        # that THIS call, with the knob unset, adds no thread and no
+        # process-wide server)
+        monkeypatch.delenv("DS_TPU_OPS_PORT", raising=False)
+        from deepspeed_tpu.telemetry.ops_plane import get_ops_server
+        before = set(threading.enumerate())
+        assert maybe_start_ops_server() is None
+        assert get_ops_server() is None
+        assert set(threading.enumerate()) == before
+
+
+# ------------------------------------------------------------ flight box
+
+def _mk_monitor():
+    reg = MetricsRegistry()
+    ev = EventLog(registry=reg)
+    got = []
+    hm = HealthMonitor(registry=reg, event_log=ev,
+                       sinks=[CallbackAlertSink(got.append)])
+    ev.add_listener(hm.on_event)
+    return hm, reg, ev, got
+
+
+class TestFlightRecorder:
+
+    def _manifest_of_only_capture(self, rec):
+        caps = rec.captures()
+        assert len(caps) == 1
+        return caps[0], rec.read_manifest(caps[0]["name"])
+
+    def test_nan_loss_alert_captures_once(self, tmp_path):
+        hm, reg, ev, _ = _mk_monitor()
+        hm.ensure_detector(NonFiniteLossDetector())
+        rec = FlightRecorder(str(tmp_path), max_captures=4, profile_s=0)
+        hm.add_sink(rec)
+        for _ in range(5):
+            hm.observe_loss(0.7)
+        ev.emit("enqueue", 7, prompt=4)
+        assert rec.captures() == []  # healthy training leaves no captures
+        for _ in range(25):
+            hm.observe_loss(float("nan"))  # latched: one alert, one capture
+        cap, manifest = self._manifest_of_only_capture(rec)
+        assert cap["reason"] == "nan_loss"
+        assert manifest["schema"] == 1
+        assert manifest["alert"]["detector"] == "nan_loss"
+        assert manifest["rank"]["process_count"] >= 1
+        assert any(e["kind"] == "enqueue" and e["uid"] == 7
+                   for e in manifest["events_tail"])
+        assert "health_alerts_total" in json.dumps(manifest["metrics"])
+        assert "ledger" in manifest["perf"]
+        assert manifest["knobs"]["DS_TPU_FLIGHT_MAX"]["default"] == "8"
+
+    def test_queue_stall_alert_captures_once(self, tmp_path):
+        hm, _, ev, _ = _mk_monitor()
+        hm.ensure_detector(QueueStallDetector(stall_s=0.05))
+        rec = FlightRecorder(str(tmp_path), max_captures=4, profile_s=0)
+        hm.add_sink(rec)
+        ev.emit("enqueue", 0, ts=10.0, prompt=6)
+        ev.emit("enqueue", 1, ts=10.0, prompt=4)
+        for now in (10.1, 10.5, 11.0, 12.0):  # admission never happens
+            hm.poll(now=now)
+        cap, manifest = self._manifest_of_only_capture(rec)
+        assert cap["reason"] == "queue_stall"
+        assert manifest["alert"]["pending"] == 2
+        assert len(manifest["events_tail"]) >= 2
+
+    def test_ring_never_exceeds_bound(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), max_captures=3, profile_s=0)
+        for i in range(7):
+            rec.capture(reason=f"manual_{i}")
+        names = sorted(e for e in os.listdir(tmp_path)
+                       if e.startswith("capture-"))
+        assert len(names) == 3
+        # eviction drops oldest-first: the survivors are the newest three
+        assert [n.split("-", 2)[2] for n in names] == \
+            ["manual_4", "manual_5", "manual_6"]
+        assert len(rec.captures()) == 3
+
+    def test_engine_registers_residency_provider(self, tmp_path, v2_setup,
+                                                 monkeypatch):
+        """An engine built with DS_TPU_FLIGHT_DIR wires the recorder as a
+        monitor sink and contributes allocator/prefix/host-tier residency
+        and jit-cache stats to every capture."""
+        import deepspeed_tpu.telemetry.flight as flight_mod
+        monkeypatch.setenv("DS_TPU_FLIGHT_DIR", str(tmp_path))
+        monkeypatch.setattr(flight_mod, "_RECORDER", None)
+        eng = _mk_engine(v2_setup, fused=True)
+        eng.generate([[3, 17, 42, 9]], max_new_tokens=4)
+        rec = flight_mod.get_flight_recorder()
+        assert rec is not None and rec in get_health_monitor()._sinks
+        rec.capture(reason="manual")
+        manifest = rec.read_manifest(rec.captures()[0]["name"])
+        res = manifest["residency"]
+        assert res["kv_blocks_total"] == 96
+        assert 0 < res["kv_blocks_free"] <= 96
+        assert res["block_bytes"] > 0
+        assert manifest["jit_cache"]["enabled"] in (True, False)
+        get_health_monitor().remove_sink(rec)
+        monkeypatch.setattr(flight_mod, "_RECORDER", None)
+        get_health_monitor().reset()
+
+    def test_read_manifest_rejects_traversal(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), max_captures=2, profile_s=0)
+        rec.capture(reason="ok")
+        assert rec.read_manifest("../../etc/passwd") is None
+        assert rec.read_manifest("capture-xx-bad") is None
+
+
+# --------------------------------------------------- exporter hardening
+
+class TestPrometheusHardening:
+
+    _SAMPLE = re.compile(r'^([a-z_][a-z0-9_]*)(\{(.*)\})? (\S+)$')
+    _LABEL = re.compile(r'([a-z_][a-z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def _unescape(self, v):
+        out, i = [], 0
+        while i < len(v):
+            if v[i] == "\\" and i + 1 < len(v):
+                out.append({"n": "\n", '"': '"', "\\": "\\"}.get(v[i + 1],
+                                                                v[i:i + 2]))
+                i += 2
+            else:
+                out.append(v[i])
+                i += 1
+        return "".join(out)
+
+    def test_hostile_label_values_round_trip(self):
+        hostile = 'new\nline "quoted" back\\slash'
+        reg = MetricsRegistry()
+        reg.counter("comm_bytes_total", op=hostile).inc(5)
+        reg.gauge("kv_block_occupancy", pool='a"b').set(0.5)
+        text = reg.render_prometheus()
+        recovered = {}
+        for line in text.splitlines():
+            assert "\n" not in line  # escaping keeps the format line-based
+            if line.startswith("#"):
+                continue
+            m = self._SAMPLE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            labels = {k: self._unescape(v)
+                      for k, v in self._LABEL.findall(m.group(3) or "")}
+            recovered[(m.group(1), tuple(sorted(labels.items())))] = \
+                float(m.group(4))
+        assert recovered[("comm_bytes_total", (("op", hostile),))] == 5.0
+        assert recovered[("kv_block_occupancy", (("pool", 'a"b'),))] == 0.5
+
+    def test_help_and_type_per_family(self):
+        reg = MetricsRegistry()
+        reg.counter("train_steps_total").inc()
+        reg.describe("train_steps_total", "optimizer steps\ncompleted")
+        h = reg.histogram("infer_ttft_seconds", buckets=(0.1,))
+        h.observe(0.05)
+        lines = reg.render_prometheus().splitlines()
+        assert "# HELP train_steps_total optimizer steps\\ncompleted" in lines
+        assert "# TYPE train_steps_total counter" in lines
+        assert "# HELP infer_ttft_seconds see docs/OBSERVABILITY.md" in lines
+        assert "# TYPE infer_ttft_seconds histogram" in lines
+        # HELP immediately precedes TYPE for each family
+        for i, line in enumerate(lines):
+            if line.startswith("# TYPE "):
+                assert lines[i - 1].startswith("# HELP "), lines[i - 1]
+
+
+# ------------------------------------------------------- event-log flush
+
+class TestEventLogAtexitFlush:
+
+    def test_short_lived_process_keeps_every_event(self, tmp_path):
+        """500 events emitted right before interpreter exit — without the
+        atexit flush+join the daemon drain thread dies mid-queue and the
+        JSONL file truncates."""
+        n = 500
+        path = tmp_path / "events.jsonl"
+        code = (
+            "from deepspeed_tpu.telemetry import EventLog, MetricsRegistry\n"
+            f"log = EventLog(registry=MetricsRegistry(), sink_path={str(path)!r})\n"
+            f"for i in range({n}):\n"
+            "    log.emit('decode', uid=i % 7, q=i)\n"
+        )
+        subprocess.run([sys.executable, "-c", code], check=True, timeout=120,
+                       env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        lines = path.read_text().splitlines()
+        assert len(lines) == n
+        assert json.loads(lines[-1])["q"] == n - 1
+
+
+# ----------------------------------------------------- rank aggregation
+
+def _snap(rank_idx, n_ranks, steps, latencies):
+    reg = MetricsRegistry()
+    reg.counter("train_steps_total").inc(steps)
+    reg.gauge("kv_block_occupancy").set(0.1 * (rank_idx + 1))
+    h = reg.histogram("comm_latency_seconds", buckets=(0.001, 0.01, 0.1, 1.0),
+                      op="all_reduce")
+    for lat in latencies:
+        h.observe(lat)
+    snap = reg.snapshot()
+    snap["rank"] = {"process_index": rank_idx, "process_count": n_ranks,
+                    "device_kind": "cpu"}
+    return snap
+
+
+class TestAggregation:
+
+    def test_merge_sums_counters_and_histograms(self):
+        s0 = _snap(0, 2, steps=3, latencies=[0.005] * 10)
+        s1 = _snap(1, 2, steps=4, latencies=[0.005] * 6)
+        merged = merge_snapshots([s0, s1])
+        assert merged["n_ranks"] == 2
+        assert merged["counters"]["train_steps_total"] == 7
+        h = merged["histograms"]['comm_latency_seconds{op="all_reduce"}']
+        assert h["count"] == 16
+        assert h["buckets"]["0.01"] == 16 and h["buckets"]["0.001"] == 0
+        # gauges: max wins, per-rank values retained
+        assert merged["gauges"]["kv_block_occupancy"] == pytest.approx(0.2)
+        assert merged["gauges_by_rank"]["kv_block_occupancy"] == \
+            {"0": pytest.approx(0.1), "1": pytest.approx(0.2)}
+
+    def test_merge_rejects_mismatched_buckets(self):
+        s0 = _snap(0, 2, 1, [0.005])
+        s1 = _snap(1, 2, 1, [0.005])
+        s1["histograms"]['comm_latency_seconds{op="all_reduce"}']["buckets"] = \
+            {"0.5": 1, "+Inf": 1}
+        with pytest.raises(ValueError, match="bucket edges differ"):
+            merge_snapshots([s0, s1])
+
+    def test_histogram_quantile_interpolates(self):
+        h = {"sum": 1.0, "count": 10,
+             "buckets": {"0.001": 0, "0.01": 10, "0.1": 10, "1": 10,
+                         "+Inf": 10}}
+        # all mass in (0.001, 0.01]: p50 lerps to the bucket midpoint
+        assert histogram_quantile(h, 0.5) == pytest.approx(0.0055)
+        assert histogram_quantile({"sum": 0, "count": 0, "buckets": {}},
+                                  0.5) == 0.0
+
+    def test_straggler_flags_exactly_the_slow_rank(self):
+        fast = [0.002] * 20
+        snaps = [_snap(0, 4, 1, fast), _snap(1, 4, 1, fast),
+                 _snap(2, 4, 1, [0.5] * 20), _snap(3, 4, 1, fast)]
+        report = detect_stragglers(snaps, ratio=4.0)
+        assert [s["rank"] for s in report["stragglers"]] == ["2"]
+        assert report["stragglers"][0]["ratio"] > 4.0
+        # a cold rank (too few collectives) is never judged
+        snaps[2] = _snap(2, 4, 1, [0.5] * 2)
+        assert detect_stragglers(snaps, ratio=4.0)["stragglers"] == []
+
+    def test_monitor_observe_rank_snapshots_alerts_once(self):
+        hm, reg, _, got = _mk_monitor()
+        fast = [0.002] * 20
+        snaps = [_snap(0, 2, 1, fast), _snap(1, 2, 1, [0.9] * 20)]
+        hm.observe_rank_snapshots(snaps)
+        hm.observe_rank_snapshots(snaps)  # latched: still one alert
+        assert [a.detector for a in got] == ["comm_straggler"]
+        assert got[0].attrs["ranks"] == ["1"]
+        assert not hm.healthy
+        hm.observe_rank_snapshots([_snap(0, 2, 1, fast), _snap(1, 2, 1, fast)])
+        assert hm.healthy  # skew cleared -> re-armed
+        d = hm.detector(StragglerDetector.name)
+        assert d.last_report["stragglers"] == []
+
+
+@pytest.mark.dist
+class TestDistributedAggregation:
+
+    def test_two_rank_snapshot_merge_and_straggler(self, tmp_path):
+        """Each forked rank performs a real cross-process psum, records
+        its collective latencies (rank 1 artificially 100x slower) into
+        the existing comm_latency_seconds histograms, and dumps a stamped
+        snapshot; the parent merges the files and the straggler analysis
+        flags exactly rank 1."""
+        out = run_distributed(f"""
+import numpy as np
+import jax, jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+mesh = Mesh(np.array(jax.devices()).reshape(4), ("data",))
+x = jax.make_array_from_process_local_data(
+    NamedSharding(mesh, P("data")), np.full((2,), RANK + 1.0, np.float32), (4,))
+total = jax.jit(lambda a: jnp.sum(a), out_shardings=NamedSharding(mesh, P()))(x)
+assert float(total) == 6.0, float(total)
+
+from deepspeed_tpu.comm import dump_telemetry_snapshot
+from deepspeed_tpu.telemetry import get_registry
+from deepspeed_tpu.utils.comms_logging import CommsLogger
+reg = get_registry()
+reg.counter("train_steps_total").inc(RANK + 1)
+logger = CommsLogger()
+lat = 0.002 if RANK == 0 else 0.2   # rank 1 is the straggler
+for _ in range(16):
+    logger.append("all_reduce", "all_reduce", lat, 1 << 20, 2)
+path = dump_telemetry_snapshot({str(tmp_path)!r})
+print("WROTE", path)
+""", n_procs=2, devices_per_proc=2)
+        assert all("WROTE" in o for o in out)
+
+        files = sorted(os.listdir(tmp_path))
+        assert files == ["telemetry-rank0.json", "telemetry-rank1.json"]
+        snaps = [json.load(open(os.path.join(tmp_path, f))) for f in files]
+        assert [s["rank"]["process_index"] for s in snaps] == [0, 1]
+        assert all(s["rank"]["process_count"] == 2 for s in snaps)
+
+        merged = merge_snapshots(snaps)
+        assert merged["counters"]["train_steps_total"] == 3  # 1 + 2
+        h = merged["histograms"]['comm_latency_seconds{op="all_reduce"}']
+        assert h["count"] == 32
+
+        report = detect_stragglers(snaps, ratio=4.0)
+        assert [s["rank"] for s in report["stragglers"]] == ["1"]
+
+        # the merge CLI agrees, and exits 2 to make sessions scriptable
+        proc = subprocess.run(
+            [sys.executable, os.path.join(os.path.dirname(__file__), "..", "..",
+                                          "tools", "telemetry_merge.py"),
+             str(tmp_path)],
+            capture_output=True, text=True, timeout=120,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.returncode == 2, proc.stderr
+        assert "STRAGGLER rank 1" in proc.stderr
+        assert json.loads(proc.stdout)["counters"]["train_steps_total"] == 3

@@ -119,11 +119,16 @@ def test_render_prometheus_golden():
     h = reg.histogram("step_seconds", buckets=(0.5, 1.0))
     h.observe(0.25)
     h.observe(0.75)
+    reg.describe("step_seconds", "wall time per train step")
+    help_default = "see docs/OBSERVABILITY.md"
     assert reg.render_prometheus() == (
+        f'# HELP comm_bytes_total {help_default}\n'
         '# TYPE comm_bytes_total counter\n'
         'comm_bytes_total{op="all_reduce"} 1024\n'
+        f'# HELP kv_block_occupancy {help_default}\n'
         '# TYPE kv_block_occupancy gauge\n'
         'kv_block_occupancy 0.25\n'
+        '# HELP step_seconds wall time per train step\n'
         '# TYPE step_seconds histogram\n'
         'step_seconds_bucket{le="0.5"} 1\n'
         'step_seconds_bucket{le="1"} 2\n'

@@ -39,15 +39,45 @@ print('alive:', float((jnp.ones((256,256))@jnp.ones((256,256))).sum()))" >> "$LO
     exit 1
 fi
 
+# ops-plane smoke: every serve-family rung runs with the introspection
+# server up; this curls /healthz and /perf mid-rung and archives the
+# responses, proving the plane answers while the engine is under load
+OPS_PORT=8787
+ops_smoke() {
+    local rung=$1
+    sleep 20  # let the rung get past warmup before scraping
+    for ep in healthz perf; do
+        curl -fsS -m 10 "http://127.0.0.1:$OPS_PORT/$ep" \
+            > "ops_${rung}_${ep}.json" 2>> "$LOG" \
+            && note "ops smoke $rung /$ep OK ($(wc -c < "ops_${rung}_${ep}.json") bytes)" \
+            || note "ops smoke $rung /$ep FAILED"
+    done
+}
+
 # ---- phase A: never-measured rungs (zero hardware evidence) ----
 i=0
 for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec serve_kvtier; do
     i=$((i+1))
     note "A$i/7 bench rung $rung (never measured on-chip)"
-    DS_BENCH_EXTRA=0 DS_BENCH_RUNG=$rung timeout 1800 python bench.py >> "$LOG" 2>&1
+    case $rung in
+        serve*) ops_smoke "$rung" & OPS_SMOKE_PID=$! ;;
+        *)      OPS_SMOKE_PID= ;;
+    esac
+    DS_TPU_OPS_PORT=$OPS_PORT DS_TPU_FLIGHT_DIR=flight_captures \
+        DS_BENCH_EXTRA=0 DS_BENCH_RUNG=$rung timeout 1800 python bench.py >> "$LOG" 2>&1
     note "$rung rc=$?"
+    [ -n "$OPS_SMOKE_PID" ] && wait "$OPS_SMOKE_PID" 2>/dev/null
     probe
 done
+
+# archive one manual flight capture per session: the black box of a
+# healthy run is the baseline a post-mortem diff needs
+note "manual flight capture (session baseline)"
+DS_TPU_FLIGHT_DIR=flight_captures timeout 120 python -c "
+from deepspeed_tpu.telemetry import get_flight_recorder
+rec = get_flight_recorder()
+print('flight capture:', rec.capture(reason='hw_session_baseline'))" >> "$LOG" 2>&1
+note "flight capture rc=$?"
 
 note "A7 int8 weight-only A/B (decode + serve rungs)"
 DS_BENCH_QUANT=8 DS_BENCH_EXTRA=0 DS_BENCH_RUNG=decode timeout 1200 python bench.py >> "$LOG" 2>&1
@@ -80,4 +110,4 @@ note "train sweep rc=$?"
 probe
 
 python tools/hw_summary.py > HW_SUMMARY.txt 2>&1
-note "session complete - artifacts: BENCH_extra.json + BENCH_SLA.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + $LOG"
+note "session complete - artifacts: BENCH_extra.json + BENCH_SLA.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + ops_*_{healthz,perf}.json + flight_captures/ + $LOG"

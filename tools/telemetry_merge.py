@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Merge per-rank telemetry snapshots and run the straggler analysis.
+
+Each rank writes ``telemetry-rank<k>.json`` via
+``deepspeed_tpu.comm.dump_telemetry_snapshot(dir)`` (or
+``telemetry.write_rank_snapshot``); this CLI merges them into one
+cross-rank view — counters summed, fixed-bucket histograms merged,
+gauges maxed with a per-rank breakdown — and flags collective-wait
+stragglers (a rank whose pooled ``comm_latency_seconds`` p50 exceeds
+``--ratio`` x the cross-rank median; the same analysis the
+``StragglerDetector`` runs in-process). See docs/OBSERVABILITY.md
+"Ops plane & flight recorder".
+
+Usage:
+    python tools/telemetry_merge.py <dir-or-files...> [-o merged.json]
+        [--ratio 4.0] [--min-count 8]
+
+Exit code 2 when a straggler is flagged (scriptable in session tooling).
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _expand(paths):
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(glob.glob(os.path.join(p, "telemetry-rank*.json"))))
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="+",
+                    help="snapshot files, or directories holding telemetry-rank*.json")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write the merged snapshot JSON here (default: stdout)")
+    ap.add_argument("--ratio", type=float, default=None,
+                    help="straggler threshold multiple (default: DS_TPU_STRAGGLER_X)")
+    ap.add_argument("--min-count", type=int, default=8,
+                    help="minimum recorded collectives for a rank to be judged")
+    args = ap.parse_args(argv)
+
+    from deepspeed_tpu.analysis import knobs
+    from deepspeed_tpu.telemetry.agg import detect_stragglers, merge_snapshots
+
+    files = _expand(args.paths)
+    if not files:
+        print("telemetry_merge: no snapshot files found", file=sys.stderr)
+        return 1
+    snaps = []
+    for path in files:
+        with open(path) as f:
+            snaps.append(json.load(f))
+
+    merged = merge_snapshots(snaps)
+    ratio = args.ratio if args.ratio is not None else knobs.get_float("DS_TPU_STRAGGLER_X")
+    report = detect_stragglers(snaps, ratio=ratio, min_count=args.min_count)
+    merged["straggler_report"] = report
+
+    text = json.dumps(merged, indent=2, sort_keys=True)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text + "\n")
+        print(f"telemetry_merge: wrote {args.out} ({len(files)} ranks)",
+              file=sys.stderr)
+    else:
+        print(text)
+
+    for s in report["stragglers"]:
+        print(f"telemetry_merge: STRAGGLER rank {s['rank']}: collective-wait "
+              f"p50 {s['p50'] * 1e3:.2f}ms = {s['ratio']:.1f}x the cross-rank "
+              f"median ({report['median_p50'] * 1e3:.2f}ms)", file=sys.stderr)
+    return 2 if report["stragglers"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
