@@ -250,6 +250,15 @@ declare("DS_TPU_STRAGGLER_X", "4", "float",
         "collective-wait p50 exceeds this multiple of the cross-rank "
         "median p50.",
         "telemetry/health.py")
+declare("DS_TPU_JOURNAL", "0", "bool",
+        "Record serving sessions to a black-box journal (engine "
+        "fingerprint, arrivals, quantum composition, committed-token "
+        "digests) for deterministic replay via tools/replay.py.",
+        "telemetry/journal.py")
+declare("DS_TPU_JOURNAL_DIR", "journals", "str",
+        "Directory for journal JSONL files (one per process) when "
+        "DS_TPU_JOURNAL is on.",
+        "telemetry/journal.py")
 
 # Ops / kernels
 declare("DS_TPU_OP_", None, "str",

@@ -33,6 +33,7 @@ from ...telemetry.events import get_event_log
 from ...telemetry.flight import maybe_attach_flight_recorder
 from ...telemetry.health import (HBMPressureDetector, QueueStallDetector,
                                  SLOBurnRateDetector, get_health_monitor)
+from ...telemetry.journal import get_journal
 from ...telemetry.ops_plane import maybe_start_ops_server
 from ...utils.logging import log_dist, logger
 from ...ops.pallas.paged_attention import make_kv_pool
@@ -716,11 +717,14 @@ class InferenceEngineV2:
         self._m_decode_steps.inc(steps)
         self._m_decode_tokens.inc(n * steps)
         self._m_decode_fill.set(n / len(ctx))
+        # out-of-band burst: claims its own quantum id (no schedule call)
+        q = self.scheduler.next_quantum()
         if self._events.enabled:
-            # out-of-band burst: claims its own quantum id (no schedule call)
-            q = self.scheduler.next_quantum()
             for uid in uids:
                 self._events.emit("decode", uid, q=q, k=steps)
+        journal = get_journal()
+        if journal is not None and journal.active:
+            journal.record_quantum(q, uids, [], steps=steps)
         for seq in seqs:
             seq.post_forward()
         if defer:
@@ -1040,7 +1044,8 @@ class InferenceEngineV2:
             out[uid] = [int(t) for t in committed[j, :n_commit]]
             total_acc += acc
             if ev:
-                self._events.emit("decode", uid, q=q, k=n_commit, accepted=acc)
+                self._events.emit("decode", uid, q=q, k=n_commit, accepted=acc,
+                                  proposed=int(n_draft[j]))
         total_prop = int(n_draft[:n].sum())
         # useful = committed tokens (carry + accepted drafts); slots = the
         # whole padded verify window the program actually computed
@@ -1083,12 +1088,85 @@ class InferenceEngineV2:
         if self._events.enabled:
             for i, p in enumerate(prompts):
                 self._events.emit("enqueue", i, prompt=len(p))
+        journal = get_journal()
+        if journal is not None:
+            journal.begin_session(
+                self._journal_fingerprint(), kind="generate",
+                run={"max_new_tokens": int(max_new_tokens), "eos_token_id": eos_token_id,
+                     "do_sample": bool(do_sample), "temperature": float(temperature),
+                     "top_k": int(top_k), "top_p": float(top_p), "seed": int(seed)})
+            for i, p in enumerate(prompts):
+                journal.record_request(i, list(p), arrival_s=0.0,
+                                       arrival_q=self.scheduler.last_quantum_id,
+                                       max_new_tokens=int(max_new_tokens))
         try:
             with maybe_guard(self._guard_enabled):
-                return self._generate(prompts, max_new_tokens, eos_token_id, on_token)
+                out = self._generate(prompts, max_new_tokens, eos_token_id, on_token)
+            if journal is not None:
+                # deferred mode keeps tokens on device until the final
+                # fetch, so those requests have no per-commit records —
+                # journal each one's full stream now (quantum unknown: -1)
+                for i, toks in enumerate(out):
+                    if not journal.has_commits(i):
+                        journal.record_commit(i, -1, toks)
+            return out
         finally:
+            if journal is not None:
+                journal.end_session(self._journal_run_summary())
             self._sampling = None
             self._update_hbm_gauges()
+
+    # ---------------------------------------------------------- journal
+    def _program_signatures(self) -> List[str]:
+        """Compiled-program cache signatures at this instant — part of the
+        journal fingerprint (a replay that compiles a different program
+        set is suspect before a single token diverges)."""
+        sigs = ["prefill", "decode"]
+        sigs += [f"burst{k}" for k in self._bursts]
+        sigs += [f"fused{k}" for k in self._fused_fns]
+        sigs += [f"spec{k}" for k in self._spec_fns]
+        return sorted(str(s) for s in sigs)
+
+    def _journal_fingerprint(self) -> Dict:
+        """Everything the replay harness needs to rebuild this engine:
+        model config, resolved engine geometry/loop flags, the knob
+        registry as resolved, and the program-cache signatures."""
+        from ...telemetry.flight import resolved_knobs
+
+        smc = self._config.state_manager
+        return {
+            "model_cfg": dataclasses.asdict(self.cfg),
+            "engine": {
+                "dtype": self._config.dtype,
+                "fused_step": self._fused_enabled,
+                "spec_decode": self._spec_enabled,
+                "spec_k": self._spec_k,
+                "spec_drafter": self._config.spec_drafter,
+                "decode_burst": self._config.decode_burst,
+                "min_decode_bucket": self._config.min_decode_bucket,
+                "quant_bits": self._config.quant_bits,
+                "kv_quant_bits": self._kv_quant_bits,
+                "kv_spill": self._kv_spill,
+                "enable_prefix_cache": self.state.prefix_cache is not None,
+                "tensor_parallel": self._tp,
+                "num_kv_blocks": self._n_kv_blocks,
+                "kv_block_size": smc.kv_block_size,
+                "max_context": smc.max_context,
+                "max_ragged_batch_size": smc.max_ragged_batch_size,
+                "max_ragged_sequence_count": smc.max_ragged_sequence_count,
+            },
+            "knobs": resolved_knobs(),
+            "programs": self._program_signatures(),
+        }
+
+    def _journal_run_summary(self) -> Dict:
+        """Run-level accounting folded into the journal's end record —
+        the baseline side of a what-if comparison."""
+        out: Dict = {"dispatches": get_telemetry_registry().peek("infer_dispatches_total") or 0.0,
+                     "programs": self._program_signatures()}
+        if self._acct.enabled:
+            out["acct_totals"] = dict(self._acct.totals())
+        return out
 
     def _residency_summary(self) -> Dict:
         """Allocator / prefix-cache / host-tier residency — the flight
@@ -1151,6 +1229,9 @@ class InferenceEngineV2:
     def _commit_closures(self, reqs, results, pieces, counts, decode_ready, eos_token_id, on_token):
         """(commit, commit_dev) shared by the fused and unfused loops."""
         events = self._events
+        journal = get_journal()
+        if journal is not None and not journal.active:
+            journal = None
 
         def commit(uid: int, toks_out: List[int]) -> None:
             """Record sampled tokens and retire/continue the request."""
@@ -1163,6 +1244,8 @@ class InferenceEngineV2:
                 return
             if eos_token_id is not None and eos_token_id in toks_out:
                 toks_out = toks_out[:toks_out.index(eos_token_id) + 1]
+            if journal is not None:
+                journal.record_commit(uid, self.scheduler.last_quantum_id, toks_out)
             if on_token is not None:
                 for tok in toks_out:
                     on_token(uid, tok)

@@ -14,6 +14,7 @@ from typing import List, Optional, Tuple
 
 from ...telemetry import get_registry as get_telemetry_registry
 from ...telemetry.events import get_event_log
+from ...telemetry.journal import get_journal
 from .ragged.manager import DSStateManager
 
 
@@ -172,6 +173,11 @@ class RaggedBatchScheduler:
             self._events.emit("quantum", q=q, prefills=len(prefills),
                               decodes=len(sched_decodes),
                               tokens=self.max_batch_tokens - budget)
+            journal = get_journal()
+            if journal is not None and journal.active:
+                journal.record_quantum(
+                    q, sched_decodes,
+                    [(p.uid, p.start_pos, len(p.tokens), p.final) for p in prefills])
         return ScheduledStep(prefills=prefills, decode_uids=sched_decodes)
 
     def schedule_spec(self, decode_uids: List[int], tokens_per_row: int) -> Tuple[List[int], int]:
@@ -208,6 +214,9 @@ class RaggedBatchScheduler:
         if admitted:
             self._events.emit("quantum", q=q, prefills=0, decodes=len(admitted),
                               tokens=len(admitted) * tokens_per_row, spec_k=tokens_per_row - 1)
+            journal = get_journal()
+            if journal is not None and journal.active:
+                journal.record_quantum(q, admitted, [], spec_chunk=tokens_per_row)
         return admitted, q
 
     def schedule_fused(self, pending_prefills: List[RaggedRequest], decode_uids: List[int]) -> FusedQuantum:

@@ -28,6 +28,7 @@ from ...telemetry.costs import get_perf_accountant
 from ...telemetry.events import get_event_log
 from ...telemetry.health import (QueueStallDetector, SLOBurnRateDetector,
                                  get_health_monitor)
+from ...telemetry.journal import get_journal
 from .scheduler import RaggedRequest
 
 # SLA-shaped buckets: the FastGen streaming SLA (TTFT <= 1 s,
@@ -106,6 +107,12 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
     health = get_health_monitor()
     health.ensure_detector(QueueStallDetector())
     health.ensure_detector(SLOBurnRateDetector())
+    journal = get_journal()
+    if journal is not None:
+        journal.begin_session(
+            getattr(engine, "_journal_fingerprint", lambda: {})(), kind="sla",
+            run={"eos_token_id": eos_token_id},
+            load=dataclasses.asdict(spec))
 
     t0 = time.perf_counter()
 
@@ -126,6 +133,14 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             # equals the harness's (first_token - arrival) exactly
             events.emit("enqueue", uid, ts=t0 + float(arrivals[uid]),
                         prompt=len(prompts[uid]))
+            if journal is not None:
+                # arrival-stamped with the scheduled arrival AND the
+                # scheduler's logical clock: replay can re-admit either
+                # by wall time (recorded pacing) or by quantum (logical)
+                journal.record_request(uid, prompts[uid],
+                                       arrival_s=float(arrivals[uid]),
+                                       arrival_q=engine.scheduler.last_quantum_id,
+                                       max_new_tokens=spec.max_new_tokens)
             next_idx += 1
 
     def commit(uid: int, toks_out: List[int]) -> None:
@@ -137,6 +152,8 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
             return
         if eos_token_id is not None and eos_token_id in toks_out:
             toks_out = toks_out[:toks_out.index(eos_token_id) + 1]
+        if journal is not None:
+            journal.record_commit(uid, engine.scheduler.last_quantum_id, toks_out)
         t = now()
         if not results[uid]:
             stats[uid].first_token = t
@@ -241,7 +258,15 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
 
     for uid, toks in results.items():
         stats[uid].tokens = toks
-    return [stats[i] for i in range(spec.n_requests)]
+    out = [stats[i] for i in range(spec.n_requests)]
+    if journal is not None:
+        summary = getattr(engine, "_journal_run_summary", lambda: {})()
+        try:
+            summary["sla"] = summarize(out)
+        except Exception:
+            pass  # a degenerate run (no finishes) still gets its end record
+        journal.end_session(summary)
+    return out
 
 
 def summarize(stats: Sequence[RequestStat], ttft_sla: float = 1.0,

@@ -25,6 +25,8 @@ from .agg import (detect_stragglers, histogram_quantile, merge_snapshot_files,
                   merge_snapshots, rank_stamp, write_rank_snapshot)
 from .flight import (FlightRecorder, get_flight_recorder,
                      maybe_attach_flight_recorder, resolved_knobs)
+from .journal import (Journal, Session, get_journal, journal_override,
+                      read_journal, set_journal)
 from .ops_plane import OpsServer, get_ops_server, maybe_start_ops_server
 
 __all__ = [
@@ -42,6 +44,8 @@ __all__ = [
     "merge_snapshot_files", "histogram_quantile", "detect_stragglers",
     "FlightRecorder", "get_flight_recorder", "maybe_attach_flight_recorder",
     "resolved_knobs", "OpsServer", "get_ops_server", "maybe_start_ops_server",
+    "Journal", "Session", "get_journal", "set_journal", "journal_override",
+    "read_journal",
 ]
 
 

@@ -286,19 +286,26 @@ def request_metrics(timeline: List[Dict]) -> Optional[Dict[str, float]]:
     ``tpot_s`` uses the finish record's ``n_new``."""
     ts_by: Dict[str, float] = {}
     n_new = None
+    accepted = proposed = 0
+    spec_steps = 0
     for e in timeline:
         kind = e.get("kind")
         if kind in ("enqueue", "admit", "first_token", "finish") and kind not in ts_by:
             ts_by[kind] = e["ts"]
             if kind == "finish":
                 n_new = e.get("n_new")
+        elif kind == "decode" and "accepted" in e:
+            # speculative decode events carry draft accounting
+            accepted += int(e.get("accepted", 0))
+            proposed += int(e.get("proposed", 0))
+            spec_steps += 1
     if not {"enqueue", "first_token", "finish"} <= set(ts_by):
         return None
     enq = ts_by["enqueue"]
     admit = ts_by.get("admit", enq)
     first, done = ts_by["first_token"], ts_by["finish"]
     n_new = int(n_new) if n_new else 1
-    return {
+    out = {
         "queue_s": admit - enq,
         "prefill_s": first - admit,
         "decode_s": done - first,
@@ -307,6 +314,10 @@ def request_metrics(timeline: List[Dict]) -> Optional[Dict[str, float]]:
         "total_s": done - enq,
         "n_new": float(n_new),
     }
+    if spec_steps:
+        out["accepted_tokens"] = float(accepted)
+        out["proposed_tokens"] = float(proposed)
+    return out
 
 
 def _percentile(values: List[float], q: float) -> float:

@@ -137,10 +137,21 @@ class FlightRecorder:
             "metrics": _safe(lambda: get_registry().snapshot()),
             "perf": _safe(lambda: get_perf_accountant().snapshot()),
             "knobs": _safe(resolved_knobs),
+            "journal": _safe(self._journal_section),
         }
         for name, fn in sorted(self._providers.items()):
             manifest[name] = _safe(fn)
         return manifest
+
+    @staticmethod
+    def _journal_section() -> Dict:
+        """Journal tail in the capture: when recording is on, the black
+        box carries the last records needed to replay the incident."""
+        from .journal import get_journal
+        journal = get_journal()
+        if journal is None:
+            return {"enabled": False}
+        return journal.manifest_section()
 
     def _next_seq(self) -> int:
         seq = 0

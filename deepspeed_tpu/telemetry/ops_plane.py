@@ -42,8 +42,8 @@ MAX_REQUESTS = 128         # /requests: most-recent request summaries
 MAX_TIMELINE_EVENTS = 2048  # /requests/<uid>: events across its timelines
 
 _ENDPOINTS = ("/metrics", "/healthz", "/requests", "/requests/<uid>",
-              "/perf", "/flight", "/flight/<name>", "/flight/capture (POST)",
-              "/varz")
+              "/perf", "/journal", "/flight", "/flight/<name>",
+              "/flight/capture (POST)", "/varz")
 
 
 def _json_body(payload, status: int = 200) -> Tuple[int, str, bytes]:
@@ -80,6 +80,8 @@ class OpsPlane:
             return self._request_detail(path[len("/requests/"):])
         if path == "/perf":
             return self._perf()
+        if path == "/journal":
+            return self._journal()
         if path == "/varz":
             return self._varz()
         if path == "/flight":
@@ -155,6 +157,15 @@ class OpsPlane:
         from .agg import rank_stamp
         from .costs import get_perf_accountant
         payload = get_perf_accountant().snapshot()
+        payload["rank"] = rank_stamp()
+        return _json_body(payload)
+
+    def _journal(self) -> Tuple[int, str, bytes]:
+        from .agg import rank_stamp
+        from .journal import get_journal
+        journal = get_journal()
+        payload = ({"enabled": False} if journal is None
+                   else journal.manifest_section())
         payload["rank"] = rank_stamp()
         return _json_body(payload)
 

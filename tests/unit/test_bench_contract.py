@@ -201,6 +201,42 @@ def test_event_log_overhead_within_three_percent():
     assert len(ev) > 0  # events actually recorded, not short-circuited
 
 
+def test_journal_overhead_within_three_percent():
+    """Active file-journal recording must add <3% to a serving-style
+    loop (ISSUE 15 acceptance bar). Same decomposition methodology as
+    the event-log guard above: per-commit journal cost vs a work unit
+    smaller than a real serving dispatch."""
+    import tempfile
+    import time
+
+    from deepspeed_tpu.telemetry import MetricsRegistry
+    from deepspeed_tpu.telemetry.journal import Journal
+
+    journal = Journal(tempfile.mktemp(suffix=".jsonl"), registry=MetricsRegistry())
+    journal.begin_session({}, kind="bench")
+    n_rec, n_work = 2000, 200
+
+    def record_cost():  # what one decode quantum + commit writes
+        t0 = time.perf_counter()
+        for i in range(n_rec):
+            journal.record_quantum(i, [i % 8], [])
+            journal.record_commit(i % 8, i, [42])
+        return (time.perf_counter() - t0) / n_rec
+
+    def work_cost():
+        t0 = time.perf_counter()
+        for _ in range(n_work):
+            sum(range(60000))
+        return (time.perf_counter() - t0) / n_work
+
+    record_cost(), work_cost()  # warm
+    rec = min(record_cost() for _ in range(5))
+    work = min(work_cost() for _ in range(5))
+    journal.close()
+    assert rec <= 0.03 * work, \
+        f"journal records add {rec * 1e6:.2f}us/iter to a {work * 1e6:.0f}us work unit (>{3}%)"
+
+
 def test_render_prometheus_parses_clean():
     """Every emitted series must use a legal Prometheus name and appear at
     most once — the properties a scraper actually depends on."""

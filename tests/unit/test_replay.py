@@ -1,0 +1,285 @@
+"""Record/replay subsystem (telemetry/journal.py + inference/v2/replay.py).
+
+Covers the ISSUE-15 acceptance bars: digest-exact record->replay across
+all three serving loops x prefix cache on/off, a recorded 32-request
+fused SLA session replaying token-for-token in oracle mode, a
+knob-overridden what-if replay emitting a comparative report, the
+double-run determinism audit (fast tier), and divergence injection
+pinpointing the exact request/quantum.
+"""
+
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig,
+                                        RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.replay import (build_engine_from_session,
+                                               determinism_audit,
+                                               replay_oracle, replay_whatif)
+from deepspeed_tpu.inference.v2.sla import LoadSpec, run_load
+from deepspeed_tpu.models import CausalLM
+from deepspeed_tpu.models.transformer import TransformerConfig
+from deepspeed_tpu.telemetry.events import get_event_log
+from deepspeed_tpu.telemetry.health import get_health_monitor
+from deepspeed_tpu.telemetry.journal import (Journal, journal_override,
+                                             read_journal, roll_digest,
+                                             sessions_from_records, set_journal)
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_hygiene():
+    yield
+    set_journal(None)
+    get_event_log().clear()
+    get_health_monitor().reset()
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = TransformerConfig(vocab_size=128, n_layers=2, n_heads=4, n_kv_heads=2,
+                            d_model=32, max_seq_len=128, norm="rmsnorm",
+                            activation="swiglu", pos_emb="rope", tie_embeddings=False)
+    model = CausalLM(cfg)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    return model, params
+
+
+def _engine(tiny, *, fused=False, spec=False, prefix=True):
+    model, params = tiny
+    cfg = RaggedInferenceEngineConfig(
+        state_manager=RaggedBatchConfig(kv_block_size=8, max_context=128,
+                                        num_kv_blocks=64),
+        dtype="float32", fused_step=fused, spec_decode=spec,
+        spec_k=2 if spec else None, enable_prefix_cache=prefix)
+    return InferenceEngineV2(model, params, cfg)
+
+
+_PROMPTS = [[5, 9, 2, 44], [7, 7, 1], [3, 14, 15, 92, 6], [2, 71, 8]]
+
+
+def _record_generate(tiny, **engine_kw):
+    journal = Journal()  # memory mode
+    with journal_override(journal):
+        eng = _engine(tiny, **engine_kw)
+        out = eng.generate(_PROMPTS, max_new_tokens=6)
+    session = sessions_from_records(journal.records)[-1]
+    return session, out
+
+
+# ------------------------------------------------------- journal basics
+
+class TestJournal:
+
+    def test_file_roundtrip_and_torn_line(self, tmp_path):
+        path = str(tmp_path / "j.jsonl")
+        journal = Journal(path)
+        journal.begin_session({"engine": {"dtype": "float32"}}, kind="generate",
+                              run={"seed": 3})
+        journal.record_request(0, [1, 2, 3], arrival_s=0.0, arrival_q=0,
+                               max_new_tokens=4)
+        journal.record_quantum(1, [0], [(0, 0, 3, True)])
+        journal.record_commit(0, 1, [9, 8])
+        journal.end_session({"note": "done"})
+        journal.close()
+        with open(path, "a") as f:
+            f.write('{"kind": "commit", "uid": 0, "torn...\n')  # crashed writer
+        sessions = read_journal(path)
+        assert len(sessions) == 1
+        s = sessions[0]
+        assert s.kind == "generate"
+        assert s.tokens_by_uid() == {0: [9, 8]}
+        assert s.digests() == {0: roll_digest("", [9, 8])}
+        assert s.quanta[0]["digest"]  # composition digest present
+        assert s.end["summary"] == {"note": "done"}
+
+    def test_rolling_digest_is_chunking_sensitive(self):
+        # same tokens, different commit chunking -> same final digest only
+        # when the chunk boundaries agree: the digest folds per commit
+        a = roll_digest(roll_digest("", [1, 2]), [3])
+        b = roll_digest(roll_digest("", [1, 2]), [3])
+        c = roll_digest(roll_digest("", [1, 2]), [4])
+        assert a == b != c
+
+    def test_inactive_journal_records_nothing(self):
+        journal = Journal()
+        journal.record_commit(0, 1, [1])
+        journal.record_quantum(1, [0], [])
+        assert journal.records == []
+
+    def test_manifest_section_bounded(self):
+        journal = Journal(tail=4)
+        journal.begin_session({}, kind="x")
+        for i in range(32):
+            journal.record_commit(0, i, [i])
+        section = journal.manifest_section(tail=4)
+        assert len(section["tail"]) <= 4
+        assert section["active"] is True
+        assert section["sessions_total"] == 1
+
+
+# ---------------------------------------------- record->replay equality
+
+class TestRecordReplay:
+
+    @pytest.mark.parametrize("loop_kw", [
+        dict(fused=True, spec=False),
+        dict(fused=False, spec=False),
+        dict(fused=False, spec=True),
+    ], ids=["fused", "unfused", "spec"])
+    @pytest.mark.parametrize("prefix", [True, False], ids=["prefix", "noprefix"])
+    def test_generate_digest_equality(self, tiny, loop_kw, prefix):
+        session, out = _record_generate(tiny, prefix=prefix, **loop_kw)
+        assert len(session.requests) == len(_PROMPTS)
+        recorded = session.tokens_by_uid()
+        assert recorded == {i: out[i] for i in range(len(_PROMPTS))}
+        report = replay_oracle(session, engine=_engine(tiny, prefix=prefix, **loop_kw))
+        assert report.ok, report.divergences
+        assert report.n_tokens == sum(len(t) for t in out)
+
+    def test_sla_32_request_fused_oracle(self, tiny, tmp_path):
+        """The acceptance bar: a recorded 32-request fused SLA session
+        replays token-for-token via a full engine rebuild from the
+        journal alone (meta.param_seed -> params)."""
+        model, params = tiny
+        path = str(tmp_path / "sla.jsonl")
+        journal = Journal(path)
+        journal.meta["param_seed"] = 0
+        spec = LoadSpec(n_requests=32, arrival_rate=200.0, prompt_len_range=(4, 8),
+                        max_new_tokens=6, vocab_size=128, seed=11)
+        with journal_override(journal):
+            run_load(_engine(tiny, fused=True), spec)
+        journal.close()
+
+        session = read_journal(path)[-1]
+        assert session.kind == "sla"
+        assert len(session.requests) == 32
+        assert session.header["knobs"]  # resolved knob registry captured
+        assert "programs" in session.header
+        report = replay_oracle(session, engine=build_engine_from_session(session))
+        assert report.ok, report.divergences
+        assert report.n_requests == 32
+        assert report.n_tokens == 32 * 6
+
+    @pytest.mark.fast
+    def test_determinism_audit_double_run(self, tiny):
+        result = determinism_audit(
+            lambda: _engine(tiny, fused=True),
+            spec=LoadSpec(n_requests=4, arrival_rate=1e9, prompt_len_range=(4, 6),
+                          max_new_tokens=4, vocab_size=128, seed=5))
+        assert result["deterministic"], result
+        assert result["n_requests"] == 4
+        assert result["quanta_equal"]
+
+    def test_divergence_injection_pinpoints_request_and_quantum(self, tiny):
+        session, out = _record_generate(tiny, fused=True)
+        # perturb one sampled token mid-stream in the RECORD: the oracle
+        # must localize the divergence to that request and its quantum
+        victim = next(c for c in session.commits if int(c["uid"]) == 2)
+        victim["tokens"][0] = (int(victim["tokens"][0]) + 1) % 128
+        report = replay_oracle(session, engine=_engine(tiny, fused=True))
+        assert not report.ok
+        first = report.first
+        assert first.uid == 2
+        assert first.position == 0  # first token of the tampered commit
+        assert first.quantum == int(victim["q"])
+        assert first.recorded != first.replayed
+
+    def test_whatif_emits_comparative_report(self, tiny, tmp_path):
+        path = str(tmp_path / "sla.jsonl")
+        journal = Journal(path)
+        journal.meta["param_seed"] = 0
+        spec = LoadSpec(n_requests=6, arrival_rate=1e9, prompt_len_range=(4, 6),
+                        max_new_tokens=4, vocab_size=128, seed=3)
+        with journal_override(journal):
+            run_load(_engine(tiny, fused=True), spec)
+        journal.close()
+        session = read_journal(path)[-1]
+
+        report = replay_whatif(session, {"DS_TPU_SPEC_K": 3, "spec_decode": True},
+                               timing="logical")
+        assert report["overrides"] == {"DS_TPU_SPEC_K": 3, "spec_decode": True}
+        metrics = {r["metric"] for r in report["rows"]}
+        assert {"ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tokens_per_sec",
+                "sla_miss_frac", "dispatches"} <= metrics
+        assert report["candidate"]["tokens_per_sec"] > 0
+        # the baseline side comes from the recorded end summary
+        assert report["baseline"]["tokens_per_sec"] > 0
+
+
+# ------------------------------------------------------- surfaces
+
+class TestSurfaces:
+
+    def test_ops_journal_endpoint(self):
+        from deepspeed_tpu.telemetry.ops_plane import OpsPlane
+        plane = OpsPlane()
+        set_journal(None)
+        status, _, body = plane.handle("GET", "/journal")
+        assert status == 200
+        assert json.loads(body)["enabled"] is False
+
+        journal = Journal()
+        journal.begin_session({}, kind="x")
+        journal.record_commit(0, 1, [5])
+        set_journal(journal)
+        status, _, body = plane.handle("GET", "/journal")
+        payload = json.loads(body)
+        assert status == 200
+        assert payload["enabled"] is True and payload["active"] is True
+        assert payload["tail"]  # bounded record tail surfaced
+        # the endpoint is listed in the index
+        _, _, index = plane.handle("GET", "/")
+        assert "/journal" in json.loads(index)["endpoints"]
+
+    def test_request_metrics_include_spec_acceptance(self):
+        from deepspeed_tpu.telemetry.events import request_metrics
+        tl = [
+            {"kind": "enqueue", "uid": 1, "ts": 0.0},
+            {"kind": "admit", "uid": 1, "ts": 0.1},
+            {"kind": "decode", "uid": 1, "ts": 0.2, "q": 1, "k": 3,
+             "accepted": 2, "proposed": 4},
+            {"kind": "first_token", "uid": 1, "ts": 0.2},
+            {"kind": "decode", "uid": 1, "ts": 0.3, "q": 2, "k": 2,
+             "accepted": 1, "proposed": 2},
+            {"kind": "finish", "uid": 1, "ts": 0.4, "n_new": 5},
+        ]
+        m = request_metrics(tl)
+        assert m["accepted_tokens"] == 3.0
+        assert m["proposed_tokens"] == 6.0
+
+    def test_request_detail_endpoint_carries_acceptance(self):
+        from deepspeed_tpu.telemetry.ops_plane import OpsPlane
+        ev = get_event_log()
+        ev.clear()
+        ev.emit("enqueue", 9, prompt=4)
+        ev.emit("decode", 9, q=1, k=2, accepted=1, proposed=3)
+        ev.emit("first_token", 9)
+        ev.emit("finish", 9, n_new=2)
+        status, _, body = OpsPlane().handle("GET", "/requests/9")
+        assert status == 200
+        metrics = json.loads(body)["timelines"][-1]["metrics"]
+        assert metrics["accepted_tokens"] == 1.0
+        assert metrics["proposed_tokens"] == 3.0
+
+    def test_flight_manifest_journal_section(self, tmp_path):
+        from deepspeed_tpu.telemetry.flight import FlightRecorder
+        journal = Journal()
+        journal.begin_session({}, kind="x")
+        journal.record_commit(0, 1, [7])
+        set_journal(journal)
+        rec = FlightRecorder(str(tmp_path / "flight"))
+        capture = rec.capture(reason="test")
+        with open(os.path.join(capture, "manifest.json")) as f:
+            manifest = json.load(f)
+        assert manifest["journal"]["enabled"] is True
+        assert manifest["journal"]["tail"]
+
+    def test_journal_knobs_declared(self):
+        from deepspeed_tpu.analysis import knobs
+        reg = knobs.all_knobs()
+        assert reg["DS_TPU_JOURNAL"].kind == "bool"
+        assert reg["DS_TPU_JOURNAL_DIR"].default == "journals"

@@ -70,6 +70,13 @@ for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec serve_kvtier
     probe
 done
 
+# record/replay smoke on the real chip: record an 8-request fused run,
+# then oracle-replay it token-for-token (journal lands in replay_smoke/)
+note "A7.5 replay smoke (record 8-request fused run, oracle replay)"
+timeout 600 python tools/replay.py smoke --dir replay_smoke >> "$LOG" 2>&1
+note "replay smoke rc=$?"
+probe
+
 # archive one manual flight capture per session: the black box of a
 # healthy run is the baseline a post-mortem diff needs
 note "manual flight capture (session baseline)"

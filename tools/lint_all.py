@@ -26,9 +26,26 @@ def _load_cli():
     return mod
 
 
+def _replay_smoke() -> int:
+    """Record an 8-request serving run and oracle-replay it (opt-in:
+    ``--replay-smoke``; also run directly by hw_session.sh phase A)."""
+    spec = importlib.util.spec_from_file_location(
+        "replay_cli", os.path.join(_TOOLS_DIR, "replay.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod.main(["smoke"])
+
+
 def main(argv=None) -> int:
     extra = list(argv) if argv is not None else sys.argv[1:]
-    return _load_cli().main(["--checks", "all", "--strict-baseline"] + extra)
+    smoke = "--replay-smoke" in extra
+    if smoke:
+        extra = [a for a in extra if a != "--replay-smoke"]
+    rc = _load_cli().main(["--checks", "all", "--strict-baseline"] + extra)
+    if rc == 0 and smoke:
+        rc = _replay_smoke()
+    return rc
 
 
 if __name__ == "__main__":

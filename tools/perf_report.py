@@ -146,12 +146,124 @@ def render(doc, rung=None):
     return "\n\n".join(render_snapshot(r, s) for r, s in sorted(snaps.items()))
 
 
+# --------------------------------------------------------------- diff mode
+
+# headline metric -> direction: +1 = higher is better, -1 = lower is better
+HEADLINE_METRICS = (("tokens_per_sec", +1), ("mfu", +1),
+                    ("goodput_fraction", +1), ("dispatches", -1))
+
+
+def snapshot_headline(snap):
+    """The comparable scalars of one rung's snapshot."""
+    totals = snap.get("totals") or {}
+    ledger = snap.get("ledger") or {}
+    time_s = float(totals.get("time_s") or 0.0)
+    useful = float(totals.get("useful_tokens") or 0.0)
+    return {
+        "tokens_per_sec": useful / time_s if time_s > 0 else 0.0,
+        "mfu": snap.get("mfu"),
+        "goodput_fraction": float(ledger.get("goodput_fraction") or 0.0),
+        "dispatches": float(sum(int(c.get("calls", 0)) for c in snap.get("cards") or [])),
+    }
+
+
+def diff_rows(head_a, head_b, threshold):
+    """Per-metric comparison rows; each carries a ``regressed`` verdict
+    (a drop beyond ``threshold`` in the metric's good direction)."""
+    rows = []
+    for metric, sign in HEADLINE_METRICS:
+        a, b = head_a.get(metric), head_b.get(metric)
+        row = {"metric": metric, "a": a, "b": b, "delta": None,
+               "pct": None, "regressed": False}
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            row["delta"] = b - a
+            if a:
+                row["pct"] = (b - a) / abs(a)
+                row["regressed"] = sign * row["pct"] < -threshold
+        rows.append(row)
+    return rows
+
+
+def render_compare(rows, label_a="A", label_b="B"):
+    """Render comparison rows (also reused by the replay what-if CLI:
+    any rows shaped {metric, a, b, delta[, pct, regressed]})."""
+    def cell(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.4g}"
+        return str(v)
+    table_rows = []
+    for r in rows:
+        pct = r.get("pct")
+        table_rows.append([
+            str(r["metric"]), cell(r.get("a")), cell(r.get("b")),
+            cell(r.get("delta")),
+            f"{100.0 * pct:+.1f}%" if isinstance(pct, (int, float)) else "-",
+            "REGRESSED" if r.get("regressed") else "",
+        ])
+    return _table(["metric", label_a, label_b, "delta", "pct", ""], table_rows)
+
+
+def render_diff(doc_a, doc_b, label_a, label_b, rung=None, threshold=0.05):
+    """Compare two BENCH_PERF.json artifacts per rung. Returns
+    (report text, regressed flag)."""
+    snaps_a = doc_a.get("snapshots") or {}
+    snaps_b = doc_b.get("snapshots") or {}
+    rungs = sorted(set(snaps_a) & set(snaps_b))
+    if rung is not None:
+        if rung not in rungs:
+            raise KeyError(f"rung {rung!r} not in both artifacts (common: {rungs})")
+        rungs = [rung]
+    out, regressed = [], False
+    for r in rungs:
+        rows = diff_rows(snapshot_headline(snaps_a[r]), snapshot_headline(snaps_b[r]),
+                         threshold)
+        regressed = regressed or any(row["regressed"] for row in rows)
+        out.append(f"== {r} ==  ({label_a} -> {label_b}, threshold {100.0 * threshold:.0f}%)")
+        out.append(render_compare(rows, label_a=label_a, label_b=label_b))
+    only_a = sorted(set(snaps_a) - set(snaps_b))
+    only_b = sorted(set(snaps_b) - set(snaps_a))
+    if only_a:
+        out.append(f"(rungs only in {label_a}: {', '.join(only_a)})")
+    if only_b:
+        out.append(f"(rungs only in {label_b}: {', '.join(only_b)})")
+    if not rungs:
+        out.append("no common rungs to compare")
+    return "\n\n".join(out), regressed
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=_DEF_PATH, help="BENCH_PERF.json path")
     ap.add_argument("--rung", default=None, help="render one rung's snapshot only")
     ap.add_argument("--json", action="store_true", help="echo the (selected) raw JSON instead")
+    ap.add_argument("--diff", nargs=2, metavar=("A.json", "B.json"), default=None,
+                    help="compare two BENCH_PERF.json snapshots per rung "
+                         "(tokens/s, MFU, goodput, dispatches); exits 1 on "
+                         "a regression beyond --threshold")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="relative regression threshold for --diff (default 0.05)")
     args = ap.parse_args(argv)
+    if args.diff is not None:
+        path_a, path_b = args.diff
+        try:
+            with open(path_a) as f:
+                doc_a = json.load(f)
+            with open(path_b) as f:
+                doc_b = json.load(f)
+        except OSError as e:
+            print(f"perf_report: cannot read diff input: {e}", file=sys.stderr)
+            return 1
+        try:
+            text, regressed = render_diff(doc_a, doc_b,
+                                          os.path.basename(path_a), os.path.basename(path_b),
+                                          rung=args.rung, threshold=args.threshold)
+        except KeyError as e:
+            print(f"perf_report: {e.args[0]}", file=sys.stderr)
+            return 1
+        print(text)
+        return 1 if regressed else 0
     try:
         with open(args.path) as f:
             doc = json.load(f)
