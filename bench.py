@@ -1014,12 +1014,22 @@ def main():
         maybe_start_ops_server()
     except Exception as e:
         print(f"[bench] ops plane unavailable: {type(e).__name__}: {e}", file=sys.stderr)
+    # a committed tuned profile (DS_TPU_TUNED_PROFILE=path|auto) overlays
+    # the knob registry for every rung below; env vars still win per-knob
+    try:
+        from deepspeed_tpu.autotune.profile import maybe_load_tuned_profile
+        prof = maybe_load_tuned_profile()
+        if prof is not None:
+            print(f"[bench] tuned profile active: {prof.device_kind} "
+                  f"hash={prof.provenance_hash()}")
+    except Exception as e:
+        print(f"[bench] tuned profile unavailable: {type(e).__name__}: {e}", file=sys.stderr)
 
     import jax
 
     from deepspeed_tpu.utils.compile_cache import enable_compilation_cache
 
-    enable_compilation_cache(jax, os.path.join(os.path.dirname(os.path.abspath(__file__)), '.jax_cache_tpu'))
+    enable_compilation_cache(jax, os.path.join(os.path.dirname(os.path.abspath(__file__)), '.jax_cache_tpu'), min_compile_secs=1.0)
     import jax.numpy as jnp
     import numpy as np
 

@@ -33,6 +33,13 @@ class Knob:
 
 _REGISTRY: Dict[str, Knob] = {}
 
+# Tuned-profile overlay (autotune/profile.py): knob values loaded from a
+# committed profiles/<device_kind>.json file. Precedence per knob is
+# explicit env > profile > call-site default > declared default, so an
+# operator export always wins over the tuned operating point.
+_PROFILE: Dict[str, str] = {}
+_PROFILE_META: Dict[str, object] = {}
+
 # Prefix knobs: dynamically-named families like DS_TPU_OP_<NAME> used by the
 # op registries. Reads of names starting with one of these prefixes are
 # sanctioned without per-name declarations.
@@ -84,15 +91,66 @@ def _lookup(name: str) -> Knob:
         ) from None
 
 
+def set_profile(overlay: Dict[str, str], meta: Optional[Dict[str, object]] = None) -> None:
+    """Install a tuned-profile knob overlay (values as env-style strings).
+
+    Every key must be a declared knob; the overlay sits between the
+    environment and the declared defaults in every ``get_*`` resolution.
+    """
+    for name, value in overlay.items():
+        _lookup(name)
+        if not isinstance(value, str):
+            raise TypeError(f"profile value for {name} must be a string (got {type(value).__name__})")
+    _PROFILE.clear()
+    _PROFILE.update(overlay)
+    _PROFILE_META.clear()
+    _PROFILE_META.update(meta or {})
+
+
+def clear_profile() -> None:
+    _PROFILE.clear()
+    _PROFILE_META.clear()
+
+
+def active_profile() -> Optional[Dict[str, object]]:
+    """Metadata of the installed tuned profile (None when no profile)."""
+    if not _PROFILE and not _PROFILE_META:
+        return None
+    meta = dict(_PROFILE_META)
+    meta["knobs"] = dict(_PROFILE)
+    meta["env_overridden"] = sorted(n for n in _PROFILE if n in os.environ)
+    return meta
+
+
+def provenance(name: str) -> str:
+    """Where the current value of ``name`` comes from: 'env' | 'profile' | 'default'."""
+    _lookup(name)
+    if name in os.environ:
+        return "env"
+    if name in _PROFILE:
+        return "profile"
+    return "default"
+
+
+def _raw(name: str) -> Optional[str]:
+    """env > profile, else None."""
+    raw = os.environ.get(name)
+    if raw is None:
+        raw = _PROFILE.get(name)
+    return raw
+
+
 def get_str(name: str, default: Optional[str] = None) -> Optional[str]:
     knob = _lookup(name)
-    fallback = default if default is not None else knob.default
-    return os.environ.get(name, fallback)
+    raw = _raw(name)
+    if raw is not None:
+        return raw
+    return default if default is not None else knob.default
 
 
 def get_int(name: str, default: Optional[int] = None) -> int:
     knob = _lookup(name)
-    raw = os.environ.get(name)
+    raw = _raw(name)
     if raw is None or raw == "":
         if default is not None:
             return default
@@ -102,7 +160,7 @@ def get_int(name: str, default: Optional[int] = None) -> int:
 
 def get_float(name: str, default: Optional[float] = None) -> float:
     knob = _lookup(name)
-    raw = os.environ.get(name)
+    raw = _raw(name)
     if raw is None or raw == "":
         if default is not None:
             return default
@@ -115,7 +173,7 @@ _TRUTHY: Tuple[str, ...] = ("1", "true", "yes", "on")
 
 def get_bool(name: str, default: Optional[bool] = None) -> bool:
     knob = _lookup(name)
-    raw = os.environ.get(name)
+    raw = _raw(name)
     if raw is None:
         if default is not None:
             return default
@@ -124,8 +182,9 @@ def get_bool(name: str, default: Optional[bool] = None) -> bool:
 
 
 def is_set(name: str) -> bool:
+    """True when the knob is explicitly set (environment or tuned profile)."""
     _lookup(name)
-    return name in os.environ
+    return name in os.environ or name in _PROFILE
 
 
 # ---------------------------------------------------------------------------
@@ -142,6 +201,32 @@ declare("DS_TPU_SPEC_DECODE", "0", "bool",
 declare("DS_TPU_SPEC_K", "4", "int",
         "Speculation depth: draft tokens proposed per verify dispatch.",
         "inference/v2/engine_v2.py")
+declare("DS_TPU_DECODE_BURST", "32", "int",
+        "Max fused greedy-decode steps per dispatch (0 disables bursting).",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_MIN_DECODE_BUCKET", "8", "int",
+        "Floor for the padded decode batch bucket (1 restores exact "
+        "power-of-two bucketing; bigger trades padding for fewer compiles).",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_PREFILL_CHUNK", "512", "int",
+        "SplitFuse prefill chunk size: long prompts enter the ragged batch "
+        "in chunks of this many tokens.",
+        "inference/v2/scheduler.py")
+declare("DS_TPU_MAX_BATCH_TOKENS", "0", "int",
+        "Scheduler quantum token budget override (0 keeps the state-manager "
+        "config value, default 768).",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_PROGRAM_CACHE", "8", "int",
+        "Max live compiled variants per serving program family (fused step, "
+        "decode burst, spec verify) before LRU eviction.",
+        "inference/v2/engine_v2.py")
+
+# Closed-loop autotuning (autotune/, docs/OBSERVABILITY.md "Closing the loop")
+declare("DS_TPU_TUNED_PROFILE", None, "str",
+        "Path to a tuned-profile JSON (profiles/<device_kind>.json) whose "
+        "knob vector overlays the defaults; 'auto' resolves profiles/ by "
+        "device kind. Explicit env knobs always win over the profile.",
+        "autotune/profile.py")
 
 # Paged-KV state manager (inference/v2/ragged/manager.py)
 declare("DS_TPU_PREFIX_CACHE", "1", "bool",

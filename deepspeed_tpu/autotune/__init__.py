@@ -1,0 +1,26 @@
+"""Closed-loop serving autotuner (docs/OBSERVABILITY.md "Closing the loop").
+
+Rebuilds the reference DeepSpeed autotuning layer on this repo's
+observability substrate: a recorded journal session is the benchmark, the
+what-if replay harness is the evaluator, the PR-8 goodput ledger is the
+objective, and the winner ships as a committed tuned profile the engine
+loads per device kind (``DS_TPU_TUNED_PROFILE``).
+
+- :mod:`space`   — the serving knob space + deterministic grids
+- :mod:`search`  — successive halving with analytic Pareto pruning
+- :mod:`tuner`   — the replay-backed evaluator + end-to-end autotune
+- :mod:`profile` — tuned-profile files and the knob-registry overlay
+"""
+
+from .profile import (TunedProfile, load_profile, maybe_load_tuned_profile,
+                      profile_provenance, save_profile)
+from .search import SearchResult, Trial, successive_halving
+from .space import DEFAULT_SPACE, Dim, config_key, grid, neighborhood
+from .tuner import autotune_session, evaluate_config, predict_padding, analytic_prune
+
+__all__ = [
+    "TunedProfile", "load_profile", "save_profile", "maybe_load_tuned_profile",
+    "profile_provenance", "SearchResult", "Trial", "successive_halving",
+    "DEFAULT_SPACE", "Dim", "grid", "neighborhood", "config_key",
+    "autotune_session", "evaluate_config", "predict_padding", "analytic_prune",
+]

@@ -1,0 +1,143 @@
+"""Successive halving over an arbitrary evaluator.
+
+Pure control flow, no engine imports: the evaluator is a callable
+``evaluate(config, budget) -> dict`` returning at least ``objective``
+(float, higher is better) and ``constraint_ok`` (bool). That keeps the
+search unit-testable against a fake deterministic evaluator (pruning
+order, budget accounting, constraint rejection, tie-breaking) while the
+real evaluator replays recorded traces (tuner.py).
+
+Semantics:
+
+- rounds run at increasing budgets; after each round the top ``1/eta``
+  of constraint-passing survivors advance;
+- a config that violates the constraint is rejected in the round it
+  violates and never re-evaluated at a higher budget;
+- ties on the objective break on :func:`space.config_key` — a total,
+  content-derived order, so reruns and resumes pick the same winner;
+- every evaluation is logged as a :class:`Trial` and counted against
+  ``budget_spent`` (sum of per-evaluation budgets), the number the CLI
+  reports and the smoke test asserts against.
+"""
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..telemetry import get_registry as _get_registry
+from .space import Config, config_key
+
+
+@dataclass
+class Trial:
+    """One evaluation of one config at one budget."""
+
+    config: Config
+    budget: int
+    rnd: int                      # 0-based round index
+    objective: Optional[float]    # None when the evaluation failed
+    constraint_ok: bool
+    info: Dict = field(default_factory=dict)
+
+    @property
+    def key(self) -> str:
+        return config_key(self.config)
+
+
+@dataclass
+class SearchResult:
+    winner: Optional[Config]
+    winner_trial: Optional[Trial]
+    trials: List[Trial]
+    rejected: List[Trial]         # constraint violators, in rejection order
+    budget_spent: int
+    rounds: List[Dict]            # per-round {budget, n_in, n_out, n_rejected}
+
+    @property
+    def leaderboard(self) -> List[Trial]:
+        """Final-round trials, best first."""
+        last = max((t.rnd for t in self.trials), default=-1)
+        final = [t for t in self.trials if t.rnd == last and t.constraint_ok
+                 and t.objective is not None]
+        return sorted(final, key=lambda t: (-t.objective, t.key))
+
+
+def _rank(trials: Sequence[Trial]) -> List[Trial]:
+    """Best-first, deterministic: objective desc, then canonical key asc."""
+    return sorted(trials, key=lambda t: (-(t.objective if t.objective is not None
+                                           else float("-inf")), t.key))
+
+
+def successive_halving(configs: Sequence[Config],
+                       evaluate: Callable[[Config, int], Dict],
+                       budgets: Sequence[int],
+                       eta: int = 2,
+                       min_survivors: int = 1) -> SearchResult:
+    """Run successive halving and return the winner + full trial log.
+
+    ``budgets`` is the per-round evaluation budget (e.g. number of trace
+    requests to replay), one entry per round, ascending. With a single
+    budget entry this degrades to exhaustive evaluation + argmax.
+    """
+    if eta < 2:
+        raise ValueError(f"eta must be >= 2, got {eta}")
+    if not budgets:
+        raise ValueError("successive_halving needs at least one round budget")
+    if list(budgets) != sorted(budgets):
+        raise ValueError(f"round budgets must be ascending, got {list(budgets)}")
+    # deterministic entry order no matter how the space was generated
+    alive: List[Config] = sorted({config_key(c): dict(c) for c in configs}.values(),
+                                 key=config_key)
+    if not alive:
+        raise ValueError("successive_halving needs at least one config")
+
+    tele = _get_registry()
+    m_trials = tele.counter("autotune_trials_total")
+    m_rejected = tele.counter("autotune_rejected_total")
+
+    trials: List[Trial] = []
+    rejected: List[Trial] = []
+    rounds: List[Dict] = []
+    spent = 0
+
+    for rnd, budget in enumerate(budgets):
+        round_trials: List[Trial] = []
+        n_in = len(alive)
+        for config in alive:
+            try:
+                report = evaluate(config, budget)
+                trial = Trial(config=config, budget=int(budget), rnd=rnd,
+                              objective=(None if report.get("objective") is None
+                                         else float(report["objective"])),
+                              constraint_ok=bool(report.get("constraint_ok", True)),
+                              info={k: v for k, v in report.items()
+                                    if k not in ("objective", "constraint_ok")})
+            except Exception as exc:  # an un-evaluable config is a rejection, not a crash
+                trial = Trial(config=config, budget=int(budget), rnd=rnd,
+                              objective=None, constraint_ok=False,
+                              info={"error": f"{type(exc).__name__}: {exc}"})
+            spent += int(budget)
+            m_trials.inc()
+            trials.append(trial)
+            if trial.constraint_ok and trial.objective is not None:
+                round_trials.append(trial)
+            else:
+                rejected.append(trial)
+                m_rejected.inc()
+        survivors = _rank(round_trials)
+        if rnd < len(budgets) - 1:
+            keep = max(min_survivors, (len(survivors) + eta - 1) // eta)
+            survivors = survivors[:keep]
+        alive = [t.config for t in survivors]
+        rounds.append({"budget": int(budget), "n_in": n_in,
+                       "n_out": len(alive), "n_rejected": n_in - len(round_trials)})
+        if not alive:
+            break
+
+    final = _rank([t for t in trials if t.rnd == len(rounds) - 1
+                   and t.constraint_ok and t.objective is not None])
+    winner = final[0] if final else None
+    if winner is not None:
+        tele.gauge("autotune_best_objective").set(float(winner.objective))
+    return SearchResult(winner=dict(winner.config) if winner else None,
+                        winner_trial=winner, trials=trials, rejected=rejected,
+                        budget_spent=spent, rounds=rounds)

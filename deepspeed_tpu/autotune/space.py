@@ -1,0 +1,93 @@
+"""The serving knob space the autotuner searches.
+
+Every dimension is a declared ``DS_TPU_*`` knob (analysis/knobs.py) with a
+small ordered set of candidate values, spelled as env-style strings — the
+same spelling ``replay.build_engine_from_session`` accepts as overrides
+and ``TunedProfile`` files commit. Keeping the space declarative means the
+CLI can subset it (``--dim DS_TPU_SPEC_K=2,4,8``) and tests can substitute
+toy spaces without touching the search code.
+"""
+
+from dataclasses import dataclass
+from itertools import product
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ..analysis import knobs as _knobs
+
+Config = Dict[str, str]
+
+
+@dataclass(frozen=True)
+class Dim:
+    """One searchable knob: its name and the candidate values, in order."""
+
+    name: str
+    values: Tuple[str, ...]
+
+    def __post_init__(self):
+        if not self.values:
+            raise ValueError(f"dimension {self.name} has no candidate values")
+        if not _knobs.is_declared(self.name):
+            raise KeyError(f"search dimension {self.name} is not a declared knob")
+
+
+# The default serving space (ISSUE 16): speculation depth, scheduler
+# quantum/chunk token budgets, decode bucketing (the (D,P,S) shape family),
+# KV quantization + spill watermark, and program-cache capacity. Kept
+# deliberately small per dimension — successive halving multiplies fast.
+DEFAULT_SPACE: Tuple[Dim, ...] = (
+    Dim("DS_TPU_SPEC_K", ("2", "4", "8")),
+    Dim("DS_TPU_MAX_BATCH_TOKENS", ("256", "512", "768")),
+    Dim("DS_TPU_PREFILL_CHUNK", ("128", "256", "512")),
+    Dim("DS_TPU_DECODE_BURST", ("0", "8", "32")),
+    Dim("DS_TPU_MIN_DECODE_BUCKET", ("1", "4", "8")),
+    Dim("DS_TPU_KV_QUANT", ("0", "8")),
+    Dim("DS_TPU_KV_SPILL_WATERMARK", ("0.05", "0.1", "0.2")),
+    Dim("DS_TPU_PROGRAM_CACHE", ("4", "8", "16")),
+)
+
+
+def config_key(config: Config) -> str:
+    """Canonical identity of a config — the deterministic tie-breaker."""
+    return "|".join(f"{k}={config[k]}" for k in sorted(config))
+
+
+def grid(dims: Iterable[Dim]) -> List[Config]:
+    """Full cartesian grid, in deterministic (dim-order, value-order) order."""
+    dims = list(dims)
+    out: List[Config] = []
+    for combo in product(*(d.values for d in dims)):
+        out.append({d.name: v for d, v in zip(dims, combo)})
+    return out
+
+
+def neighborhood(dims: Iterable[Dim], center: Optional[Config] = None) -> List[Config]:
+    """One-knob-at-a-time variations around ``center`` (default: each
+    dimension's declared-default value when present, else its first
+    candidate). Linear in the space size — the cheap alternative to the
+    full grid for wide spaces."""
+    dims = list(dims)
+    base: Config = {}
+    for d in dims:
+        declared = _knobs.all_knobs().get(d.name)
+        default = declared.default if declared is not None else None
+        base[d.name] = (center or {}).get(
+            d.name, default if default in d.values else d.values[0])
+    out = [dict(base)]
+    for d in dims:
+        for v in d.values:
+            if v == base[d.name]:
+                continue
+            cand = dict(base)
+            cand[d.name] = v
+            out.append(cand)
+    return out
+
+
+def parse_dim(spec: str) -> Dim:
+    """Parse a CLI dimension spec ``NAME=v1,v2,v3``."""
+    if "=" not in spec:
+        raise ValueError(f"dimension spec must be NAME=v1,v2,..., got {spec!r}")
+    name, raw = spec.split("=", 1)
+    values = tuple(v.strip() for v in raw.split(",") if v.strip())
+    return Dim(name.strip(), values)

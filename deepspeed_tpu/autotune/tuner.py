@@ -1,0 +1,224 @@
+"""The replay-backed evaluator: recorded trace in, tuned profile out.
+
+One journal session is the benchmark. Candidate knob vectors are scored
+by re-driving the recorded arrival trace through a fresh engine built
+with the overrides (``replay.build_engine_from_session`` + ``_drive_sla``)
+and reading the goodput ledger (telemetry/costs.py) — the objective — and
+the replay's TTFT percentiles — the constraint. Before any replay runs,
+an analytic padding model derived from the recorded quantum compositions
+prunes Pareto-dominated configs (the cost-card trick: padded-slot
+arithmetic is pure bookkeeping, no dispatch needed).
+"""
+
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from ..telemetry import get_registry as _get_registry
+from ..telemetry.costs import get_perf_accountant
+from ..telemetry.journal import Session
+from .profile import TunedProfile, device_kind, session_fingerprint, trace_hash
+from .search import SearchResult, successive_halving
+from .space import DEFAULT_SPACE, Config, Dim, config_key, grid
+
+# dims whose value changes the padding arithmetic the analytic model sees;
+# configs identical on every OTHER dim compete on the model's Pareto front
+_PADDING_DIMS = ("DS_TPU_MIN_DECODE_BUCKET", "DS_TPU_DECODE_BURST",
+                 "DS_TPU_PREFILL_CHUNK", "DS_TPU_MAX_BATCH_TOKENS")
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def truncate_session(session: Session, n_requests: Optional[int]) -> Session:
+    """First ``n_requests`` of the trace in arrival order (the successive-
+    halving budget unit); None or >= len keeps the full session."""
+    if n_requests is None or n_requests >= len(session.requests):
+        return session
+    order = sorted(session.requests, key=lambda u: (
+        float(session.requests[u].get("arrival_s", 0.0)), int(u)))
+    keep = set(order[:max(1, int(n_requests))])
+    sub = Session(dict(session.header))
+    sub.requests = {u: session.requests[u] for u in keep}
+    sub.quanta = list(session.quanta)
+    sub.commits = [c for c in session.commits if int(c["uid"]) in keep]
+    sub.end = session.end
+    return sub
+
+
+# ------------------------------------------------------------------ analytic model
+def predict_padding(session: Session, config: Config) -> Dict[str, float]:
+    """Cost-card-style padding arithmetic for one config on one trace.
+
+    Replays the bookkeeping, not the model: recorded quantum compositions
+    give the decode concurrency distribution; the config's bucketing knobs
+    give the padded slot count each composition would cost. Returns
+    ``pred_goodput`` (useful/slot, higher better) and ``pred_compiles``
+    (distinct padded shapes, lower better) — the two axes of the
+    dominance prune."""
+    min_bucket = max(1, int(config.get("DS_TPU_MIN_DECODE_BUCKET", 8)))
+    chunk = max(1, int(config.get("DS_TPU_PREFILL_CHUNK", 512)))
+    useful = 0
+    slot = 0
+    decode_shapes = set()
+    prefill_shapes = set()
+    for q in session.quanta:
+        rows = len(q.get("decodes") or ())
+        if rows:
+            padded = max(min_bucket, _next_pow2(rows))
+            useful += rows
+            slot += padded
+            decode_shapes.add(padded)
+    for rec in session.requests.values():
+        remaining = len(rec.get("prompt") or ())
+        while remaining > 0:
+            take = min(chunk, remaining)
+            padded = _next_pow2(take)
+            useful += take
+            slot += padded
+            prefill_shapes.add(padded)
+            remaining -= take
+    return {"pred_goodput": (useful / slot) if slot else 1.0,
+            "pred_compiles": float(len(decode_shapes) + len(prefill_shapes)),
+            "pred_useful": float(useful), "pred_slot": float(slot)}
+
+
+def analytic_prune(session: Session, configs: Sequence[Config]
+                   ) -> Tuple[List[Config], List[Config]]:
+    """Drop configs Pareto-dominated on the analytic (goodput, compiles)
+    plane by a config identical on every non-padding dim. Deterministic:
+    survivors and casualties keep canonical-key order."""
+    scored = []
+    for c in configs:
+        pred = predict_padding(session, c)
+        group = tuple((k, c[k]) for k in sorted(c) if k not in _PADDING_DIMS)
+        scored.append((group, pred, c))
+    kept: List[Config] = []
+    pruned: List[Config] = []
+    for group, pred, c in scored:
+        dominated = False
+        for g2, p2, c2 in scored:
+            if g2 != group or config_key(c2) == config_key(c):
+                continue
+            if (p2["pred_goodput"] >= pred["pred_goodput"]
+                    and p2["pred_compiles"] <= pred["pred_compiles"]
+                    and (p2["pred_goodput"] > pred["pred_goodput"]
+                         or p2["pred_compiles"] < pred["pred_compiles"])):
+                dominated = True
+                break
+        (pruned if dominated else kept).append(c)
+    kept.sort(key=config_key)
+    pruned.sort(key=config_key)
+    if pruned:
+        _get_registry().counter("autotune_pruned_total").inc(len(pruned))
+    return kept, pruned
+
+
+# ------------------------------------------------------------------ replay evaluator
+def evaluate_config(session: Session, config: Config,
+                    budget: Optional[int] = None,
+                    timing: str = "logical",
+                    objective: str = "goodput",
+                    constraint: Optional[Dict[str, float]] = None,
+                    model=None, params=None) -> Dict:
+    """Score one knob vector by replaying (a prefix of) the trace.
+
+    ``objective="goodput"`` reads the goodput ledger's useful/slot token
+    fraction — a pure token count, deterministic across replays;
+    ``"goodput_tps"`` divides useful tokens by replay wall time (faster
+    but machine-noisy). ``constraint`` maps ``sla.summarize`` keys to
+    upper bounds (e.g. ``{"ttft_p99_s": 1.0}``)."""
+    from ..inference.v2.replay import _drive_sla, build_engine_from_session
+    from ..inference.v2.sla import summarize
+
+    if objective not in ("goodput", "goodput_tps"):
+        raise ValueError(f"unknown objective {objective!r}")
+    sub = truncate_session(session, budget)
+    engine = build_engine_from_session(sub, overrides=dict(config),
+                                       model=model, params=params)
+    acct = get_perf_accountant()
+    before = acct.totals() if acct.enabled else {}
+    t0 = time.perf_counter()
+    _, stats = _drive_sla(engine, sub, timing=timing)
+    wall = time.perf_counter() - t0
+    after = acct.totals() if acct.enabled else {}
+
+    summary = summarize(stats) if any(s.done is not None for s in stats) else {}
+    useful = after.get("useful_tokens", 0.0) - before.get("useful_tokens", 0.0)
+    slot = after.get("slot_tokens", 0.0) - before.get("slot_tokens", 0.0)
+    goodput_fraction = (useful / slot) if slot else None
+    goodput_tps = (useful / wall) if wall > 0 else None
+
+    value = goodput_fraction if objective == "goodput" else goodput_tps
+    violations = {}
+    for key, limit in (constraint or {}).items():
+        got = summary.get(key)
+        if got is not None and float(got) > float(limit):
+            violations[key] = {"limit": float(limit), "got": float(got)}
+    return {"objective": value,
+            "constraint_ok": not violations,
+            "violations": violations,
+            "goodput_fraction": goodput_fraction,
+            "goodput_tps": goodput_tps,
+            "useful_tokens": useful, "slot_tokens": slot,
+            "wall_s": round(wall, 4),
+            "n_requests": len(sub.requests),
+            "summary": summary}
+
+
+# ------------------------------------------------------------------ end to end
+def autotune_session(session: Session,
+                     dims: Iterable[Dim] = DEFAULT_SPACE,
+                     configs: Optional[Sequence[Config]] = None,
+                     budgets: Optional[Sequence[int]] = None,
+                     eta: int = 2,
+                     objective: str = "goodput",
+                     constraint: Optional[Dict[str, float]] = None,
+                     timing: str = "logical",
+                     prune: bool = True,
+                     model=None, params=None) -> Dict:
+    """Search the knob space on one recorded trace; return the search
+    result plus a :class:`TunedProfile` for the winner (None when every
+    config violated the constraint).
+
+    The default-knob vector is always evaluated at full budget — it is
+    the profile's ``baseline_score`` and the bar the e2e acceptance test
+    holds the winner to."""
+    configs = list(configs) if configs is not None else grid(dims)
+    n = len(session.requests)
+    if budgets is None:
+        budgets = [n] if n <= 4 else [max(2, n // 4), n]
+
+    pruned: List[Config] = []
+    if prune:
+        configs, pruned = analytic_prune(session, configs)
+    if not configs:
+        raise ValueError("analytic pruning left no configs (space empty?)")
+
+    def _eval(config: Config, budget: int) -> Dict:
+        return evaluate_config(session, config, budget=budget, timing=timing,
+                               objective=objective, constraint=constraint,
+                               model=model, params=params)
+
+    baseline = evaluate_config(session, {}, budget=None, timing=timing,
+                               objective=objective, constraint=None,
+                               model=model, params=params)
+    result = successive_halving(configs, _eval, budgets=list(budgets), eta=eta)
+
+    profile = None
+    if result.winner is not None:
+        profile = TunedProfile(
+            device_kind=device_kind(),
+            knobs={k: str(v) for k, v in result.winner.items()},
+            engine_fingerprint=session_fingerprint(session),
+            trace_provenance=trace_hash(session),
+            objective=objective,
+            score=result.winner_trial.objective,
+            baseline_score=baseline.get("objective"),
+            constraint=dict(constraint or {}))
+    return {"result": result, "profile": profile, "baseline": baseline,
+            "n_pruned": len(pruned), "pruned": pruned,
+            "budget_spent": result.budget_spent}

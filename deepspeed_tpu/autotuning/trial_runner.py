@@ -75,7 +75,7 @@ def run_spec(spec: dict) -> dict:
         # persistent cache makes repeat searches (and CI) ~cold-start-free
         from ..utils.compile_cache import enable_compilation_cache
 
-        enable_compilation_cache(jax, cache_dir)
+        enable_compilation_cache(jax, cache_dir, min_compile_secs=1.0)
 
     from .autotuner import run_trial
 

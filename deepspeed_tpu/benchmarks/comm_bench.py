@@ -55,7 +55,12 @@ def run_comm_bench(ops: Optional[List[str]] = None, axis: str = "data", sizes_mb
                    dtype=jnp.bfloat16, trials: int = 20, warmups: int = 3, topo=None) -> List[Dict]:
     """Sweep collectives over ``axis``; returns one record per (op, size):
     {op, size_bytes, time_us, algbw_gbps, busbw_gbps}."""
-    from jax import shard_map
+    try:  # jax >= 0.6 exposes shard_map at the top level (check_vma keyword)
+        from jax import shard_map
+        sm_kw = {"check_vma": False}
+    except ImportError:  # older jax: experimental namespace
+        from jax.experimental.shard_map import shard_map
+        sm_kw = {"check_rep": False}
     from jax.sharding import PartitionSpec as P
 
     topo = topo if topo is not None else get_mesh_topology()
@@ -77,7 +82,7 @@ def run_comm_bench(ops: Optional[List[str]] = None, axis: str = "data", sizes_mb
                 jnp.ones(shape, dtype),
                 jax.sharding.NamedSharding(mesh, P(axis)))
             sharded = shard_map(fn, mesh=mesh, in_specs=P(axis),
-                                out_specs=_out_spec(op, axis), check_vma=False)
+                                out_specs=_out_spec(op, axis), **sm_kw)
             run = jax.jit(sharded)
             for _ in range(warmups):
                 out = run(x)

@@ -113,15 +113,8 @@ def ppermute(tensor, perm, group: AxisName = "pipe"):
 
 def send_recv_ring(tensor, group: AxisName = "pipe", shift: int = 1):
     """Ring shift: member i's tensor goes to member (i+shift) % n."""
-    n = lax.psum(1, group)
-    # static size needed: n is traced under shard_map only if axis unbound;
-    # callers inside shard_map get a concrete python int via axis_env.
-    size = jax.core.get_axis_env_size(group) if hasattr(jax.core, "get_axis_env_size") else None
-    if size is None:
-        try:
-            size = lax.axis_size(group)
-        except Exception:
-            size = n
+    # static size needed: the perm list is built at trace time
+    size = axis_size(group)
     perm = [(i, (i + shift) % size) for i in range(size)]
     return lax.ppermute(tensor, group, perm)
 
@@ -131,8 +124,11 @@ def axis_rank(group: AxisName):
 
 
 def axis_size(group: AxisName) -> int:
-    try:
+    """Static size of a bound mesh axis (``lax.axis_size`` is jax >= 0.6)."""
+    if hasattr(lax, "axis_size"):
         return lax.axis_size(group)
+    try:
+        return jax.core.axis_frame(group)
     except Exception:
         return lax.psum(1, group)
 

@@ -58,7 +58,8 @@ class RaggedInferenceEngineConfig:
     tensor_parallel: int = 1
     dtype: str = "bfloat16"
     interpret_kernels: Optional[bool] = None  # Pallas interpret mode; default: on unless running on real TPU
-    decode_burst: int = 32  # max fused greedy-decode steps per dispatch (0 disables bursting)
+    decode_burst: Optional[int] = None  # max fused greedy-decode steps per dispatch
+    # (0 disables bursting). None: DS_TPU_DECODE_BURST (default 32).
     fused_step: Optional[bool] = None  # ONE dispatched program per scheduler quantum (SplitFuse
     # mixed prefill+decode). None: on unless DS_TPU_SERVE_FUSED=0; the unfused
     # per-phase dispatch loop stays available as the fallback.
@@ -70,9 +71,10 @@ class RaggedInferenceEngineConfig:
     # None: off unless DS_TPU_SPEC_DECODE=1.
     spec_k: Optional[int] = None  # max draft tokens per row per step. None: DS_TPU_SPEC_K (default 4).
     spec_drafter: str = "prompt_lookup"  # drafter registry name (inference/v2/spec.py)
-    min_decode_bucket: int = 8  # floor for the padded decode batch: fewer compiled
-    # (B, steps) shapes (padded rows write to the garbage page, so a bigger
-    # bucket costs nothing real); 1 restores exact power-of-two bucketing
+    min_decode_bucket: Optional[int] = None  # floor for the padded decode batch: fewer
+    # compiled (B, steps) shapes (padded rows write to the garbage page, so a
+    # bigger bucket costs nothing real); 1 restores exact power-of-two
+    # bucketing. None: DS_TPU_MIN_DECODE_BUCKET (default 8).
     # weight-only quantization (ref inference/quantization + mixed-GEMM):
     # matmul kernels stored int8-in-HBM, dequantized in-kernel per tile
     quant_bits: int = 0  # 0 = off; 8, or 4 (TRUE packed int4 storage, 2 codes/byte)
@@ -104,11 +106,21 @@ class InferenceEngineV2:
         model's partition rules, KV pages split over heads, and the
         decode kernel runs under shard_map on the ``tensor`` axis.
         """
+        # tuned device profile (docs/OBSERVABILITY.md "Closing the loop"):
+        # install the DS_TPU_TUNED_PROFILE knob overlay before ANY knob is
+        # resolved, so every None config field below sees the tuned value
+        # (explicit env still wins inside the registry)
+        from ...autotune.profile import maybe_load_tuned_profile
+        maybe_load_tuned_profile()
         if config is None:
             config = RaggedInferenceEngineConfig()
         elif isinstance(config, dict):
             config = RaggedInferenceEngineConfig.from_dict(config)
         self._config = config
+        if config.decode_burst is None:
+            config.decode_burst = knobs.get_int("DS_TPU_DECODE_BURST")
+        if config.min_decode_bucket is None:
+            config.min_decode_bucket = max(1, knobs.get_int("DS_TPU_MIN_DECODE_BUCKET"))
         self.model = model
         cfg: TransformerConfig = model.cfg
         self.cfg = cfg
@@ -174,8 +186,14 @@ class InferenceEngineV2:
             n_blocks = max(8, int(smc.memory_gb * (1 << 30) // bytes_per_block))
         self.state = DSStateManager(smc, n_blocks, enable_prefix_cache=config.enable_prefix_cache)
         self._n_kv_blocks = int(n_blocks)
-        self.scheduler = RaggedBatchScheduler(self.state, max_batch_tokens=smc.max_ragged_batch_size,
-                                              max_sequences=smc.max_ragged_sequence_count)
+        # scheduler token budgets: quantum budget defaults to the state
+        # config; both are autotune dimensions (DS_TPU_MAX_BATCH_TOKENS=0
+        # keeps the config value)
+        quantum_tokens = knobs.get_int("DS_TPU_MAX_BATCH_TOKENS") or smc.max_ragged_batch_size
+        self.scheduler = RaggedBatchScheduler(self.state,
+                                              max_batch_tokens=int(quantum_tokens),
+                                              max_sequences=smc.max_ragged_sequence_count,
+                                              prefill_chunk=knobs.get_int("DS_TPU_PREFILL_CHUNK"))
 
         # --- telemetry (docs/OBSERVABILITY.md) ---
         tele = get_telemetry_registry()
@@ -295,6 +313,9 @@ class InferenceEngineV2:
             self._prefill_fn = self.jit_auditor.wrap("prefill", self._prefill_fn)
             self._decode_fn = self.jit_auditor.wrap("decode", self._decode_fn)
         self._guard_enabled = knobs.get_bool("DS_TPU_TRANSFER_GUARD")
+        # program-cache capacity (burst/fused/spec families share it); an
+        # autotune dimension — bigger caches trade HBM for fewer recompiles
+        self._max_program_variants = max(1, knobs.get_int("DS_TPU_PROGRAM_CACHE"))
         self._bursts: Dict[tuple, object] = {}  # sampling signature -> jitted burst
         self._fused_fns: Dict[tuple, object] = {}  # (bucket shape, sampling) -> jitted fused step
         self._cow_fn = None  # lazily-jitted donated page copy for copy-on-write
@@ -322,7 +343,7 @@ class InferenceEngineV2:
                  + (f", kv_quant=int{self._kv_quant_bits}" if self._kv_quant_bits else "")
                  + (", kv_spill=host" if self._spill_mgr is not None else ""), ranks=[0])
 
-    _MAX_BURST_VARIANTS = 8
+    _MAX_BURST_VARIANTS = 8  # class default; instances use DS_TPU_PROGRAM_CACHE
 
     def _burst_for(self, sampling):
         """Cached jitted burst per sampling signature (greedy = None).
@@ -335,7 +356,7 @@ class InferenceEngineV2:
             return None
         key = sampling or (False, 1.0, 0, 1.0)
         if key not in self._bursts:
-            if len(self._bursts) >= self._MAX_BURST_VARIANTS:
+            if len(self._bursts) >= getattr(self, "_max_program_variants", self._MAX_BURST_VARIANTS):
                 self._bursts.pop(next(iter(self._bursts)))
             do, t, k, p = key
             fn = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
@@ -753,7 +774,7 @@ class InferenceEngineV2:
             S = max(16, _next_pow2(max_chunk))
         return D, P, S
 
-    _MAX_FUSED_VARIANTS = 8
+    _MAX_FUSED_VARIANTS = 8  # class default; instances use DS_TPU_PROGRAM_CACHE
 
     def _fused_for(self, n_dec: int, n_pre: int, chunk: int, sampling):
         """LRU-bounded cache of fused-step programs keyed on the padded
@@ -764,7 +785,7 @@ class InferenceEngineV2:
         table's leading dim, so one wrapper serves the whole ladder."""
         key = (n_dec, n_pre, chunk) + (sampling or (False, 1.0, 0, 1.0))
         if key not in self._fused_fns:
-            if len(self._fused_fns) >= self._MAX_FUSED_VARIANTS:
+            if len(self._fused_fns) >= getattr(self, "_max_program_variants", self._MAX_FUSED_VARIANTS):
                 self._fused_fns.pop(next(iter(self._fused_fns)))
             do, t, k, p = key[3:]
             fn = make_fused_step_fn(self._run_cfg, interpret=self._interpret,
@@ -927,7 +948,7 @@ class InferenceEngineV2:
         return out
 
     # ---------------------------------------------------------- speculative decode
-    _MAX_SPEC_VARIANTS = 8
+    _MAX_SPEC_VARIANTS = 8  # class default; instances use DS_TPU_PROGRAM_CACHE
 
     def _spec_for(self, chunk: int, sampling):
         """LRU-bounded cache of spec-verify programs keyed on (window
@@ -936,7 +957,7 @@ class InferenceEngineV2:
         shape specialization; only the verify window is static."""
         key = (chunk,) + (sampling or (False, 1.0, 0, 1.0))
         if key not in self._spec_fns:
-            if len(self._spec_fns) >= self._MAX_SPEC_VARIANTS:
+            if len(self._spec_fns) >= getattr(self, "_max_program_variants", self._MAX_SPEC_VARIANTS):
                 self._spec_fns.pop(next(iter(self._spec_fns)))
             do, t, k, p = key[1:]
             fn = make_spec_verify_fn(self._run_cfg, interpret=self._interpret,

@@ -47,6 +47,8 @@ _KNOB_TO_FIELD = {
     "DS_TPU_KV_QUANT": "kv_quant_bits",
     "DS_TPU_KV_SPILL": "kv_spill",
     "DS_TPU_PREFIX_CACHE": "enable_prefix_cache",
+    "DS_TPU_DECODE_BURST": "decode_burst",
+    "DS_TPU_MIN_DECODE_BUCKET": "min_decode_bucket",
 }
 # engine-dict keys that live on RaggedBatchConfig, not the engine config
 _STATE_FIELDS = ("max_ragged_batch_size", "max_ragged_sequence_count",
@@ -174,8 +176,10 @@ def build_engine_from_session(session: Session, overrides: Optional[Dict] = None
         spec_decode=eng.get("spec_decode"),
         spec_k=eng.get("spec_k"),
         spec_drafter=str(eng.get("spec_drafter", "prompt_lookup")),
-        decode_burst=int(eng.get("decode_burst", 32)),
-        min_decode_bucket=int(eng.get("min_decode_bucket", 8)),
+        decode_burst=(None if eng.get("decode_burst") is None
+                      else int(eng["decode_burst"])),
+        min_decode_bucket=(None if eng.get("min_decode_bucket") is None
+                           else int(eng["min_decode_bucket"])),
         quant_bits=int(eng.get("quant_bits", 0)),
         kv_quant_bits=eng.get("kv_quant_bits"),
         kv_spill=eng.get("kv_spill"),

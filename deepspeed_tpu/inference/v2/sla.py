@@ -85,6 +85,10 @@ def run_load(engine, spec: LoadSpec, eos_token_id: Optional[int] = None) -> List
     # port knob is unset, or when the engine already started the server)
     from ...telemetry.ops_plane import maybe_start_ops_server
     maybe_start_ops_server()
+    # pick up a committed tuned profile (DS_TPU_TUNED_PROFILE) for any
+    # knob read during the run; idempotent and a no-op when unset
+    from ...autotune.profile import maybe_load_tuned_profile
+    maybe_load_tuned_profile()
     rng = np.random.default_rng(spec.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / spec.arrival_rate, spec.n_requests))
     lo, hi = spec.prompt_len_range

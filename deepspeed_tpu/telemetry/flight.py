@@ -58,6 +58,31 @@ def resolved_knobs() -> Dict:
     return out
 
 
+def knob_provenance() -> Dict[str, str]:
+    """Per-knob source of the resolved value ('env' | 'profile' |
+    'default') — how /varz attributes a knob to the tuned profile."""
+    out: Dict[str, str] = {}
+    for name in sorted(knobs.all_knobs()):
+        try:
+            out[name] = knobs.provenance(name)
+        except Exception:
+            out[name] = "unknown"
+    return out
+
+
+def tuned_profile_section() -> Dict:
+    """The active tuned profile (autotune/profile.py) as captures and
+    ``/varz`` report it: file, knob vector, provenance hash, env shadowing.
+    ``{"active": False}`` when no profile is installed."""
+    from ..autotune.profile import profile_provenance
+    prov = profile_provenance()
+    if prov is None:
+        return {"active": False}
+    prov = dict(prov)
+    prov["active"] = True
+    return prov
+
+
 def _safe(section: Callable[[], object]):
     try:
         return section()
@@ -137,6 +162,8 @@ class FlightRecorder:
             "metrics": _safe(lambda: get_registry().snapshot()),
             "perf": _safe(lambda: get_perf_accountant().snapshot()),
             "knobs": _safe(resolved_knobs),
+            "knob_provenance": _safe(knob_provenance),
+            "tuned_profile": _safe(tuned_profile_section),
             "journal": _safe(self._journal_section),
         }
         for name, fn in sorted(self._providers.items()):

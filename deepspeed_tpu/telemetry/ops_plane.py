@@ -171,8 +171,10 @@ class OpsPlane:
 
     def _varz(self) -> Tuple[int, str, bytes]:
         from .agg import rank_stamp
-        from .flight import resolved_knobs
-        return _json_body({"rank": rank_stamp(), "knobs": resolved_knobs()})
+        from .flight import knob_provenance, resolved_knobs, tuned_profile_section
+        return _json_body({"rank": rank_stamp(), "knobs": resolved_knobs(),
+                           "knob_provenance": knob_provenance(),
+                           "tuned_profile": tuned_profile_section()})
 
     def _flight_list(self) -> Tuple[int, str, bytes]:
         from .flight import get_flight_recorder

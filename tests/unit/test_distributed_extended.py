@@ -33,7 +33,12 @@ def test_collectives_ladder_two_procs():
 import numpy as np
 import jax, jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+    _SM_KW = {"check_vma": False}
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+    _SM_KW = {"check_rep": False}
 from functools import partial
 from jax.experimental import multihost_utils
 from deepspeed_tpu.comm import collectives as C
@@ -45,7 +50,7 @@ x = jax.make_array_from_process_local_data(
     NamedSharding(mesh, P("data")),
     np.arange(2, dtype=np.float32).reshape(2, 1) + RANK * 2, (4, 1))
 
-sm = partial(shard_map, mesh=mesh, in_specs=P("data", None), check_vma=False)
+sm = partial(shard_map, mesh=mesh, in_specs=P("data", None), **_SM_KW)
 
 ag = jax.jit(sm(lambda a: C.all_gather_into_tensor(a, group="data"),
                 out_specs=P(None, None)))(x)

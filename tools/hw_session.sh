@@ -77,6 +77,14 @@ timeout 600 python tools/replay.py smoke --dir replay_smoke >> "$LOG" 2>&1
 note "replay smoke rc=$?"
 probe
 
+# close the loop on the real chip: record a tiny trace, run a
+# small-budget autotune over it, and assert the winning profile
+# round-trips through a fresh engine (journal + profile in autotune_smoke/)
+note "A7.6 autotune smoke (record trace, small-budget search, profile round-trip)"
+timeout 900 python tools/autotune_serve.py smoke --dir autotune_smoke >> "$LOG" 2>&1
+note "autotune smoke rc=$?"
+probe
+
 # archive one manual flight capture per session: the black box of a
 # healthy run is the baseline a post-mortem diff needs
 note "manual flight capture (session baseline)"

@@ -273,7 +273,7 @@ def main():
 
     from deepspeed_tpu.utils.compile_cache import enable_compilation_cache
 
-    enable_compilation_cache(jax, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), '.jax_cache_tpu'))
+    enable_compilation_cache(jax, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), '.jax_cache_tpu'), min_compile_secs=1.0)
 
     plat = jax.devices()[0].platform
     print(f"[hw_smoke] platform={plat}")

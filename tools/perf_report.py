@@ -169,19 +169,47 @@ def snapshot_headline(snap):
 
 def diff_rows(head_a, head_b, threshold):
     """Per-metric comparison rows; each carries a ``regressed`` verdict
-    (a drop beyond ``threshold`` in the metric's good direction)."""
+    (a drop beyond ``threshold`` in the metric's good direction).
+    ``threshold`` is a float, or a callable ``metric -> float`` for
+    per-metric budgets (see :func:`threshold_resolver`)."""
+    budget_for = threshold if callable(threshold) else (lambda _m: threshold)
     rows = []
     for metric, sign in HEADLINE_METRICS:
         a, b = head_a.get(metric), head_b.get(metric)
+        budget = float(budget_for(metric))
         row = {"metric": metric, "a": a, "b": b, "delta": None,
-               "pct": None, "regressed": False}
+               "pct": None, "regressed": False, "budget": budget}
         if isinstance(a, (int, float)) and isinstance(b, (int, float)):
             row["delta"] = b - a
             if a:
                 row["pct"] = (b - a) / abs(a)
-                row["regressed"] = sign * row["pct"] < -threshold
+                row["regressed"] = sign * row["pct"] < -budget
         rows.append(row)
     return rows
+
+
+def threshold_resolver(thresholds, rung, fallback):
+    """Budget lookup for one rung from a thresholds document
+    (``tools/perf_thresholds.json``):
+
+        {"default": 0.05,
+         "rungs": {"serve": {"default": 0.08,
+                             "metrics": {"dispatches": 0.0}}}}
+
+    Resolution order per metric: ``rungs[rung].metrics[metric]`` ->
+    ``rungs[rung].default`` -> file ``default`` -> ``fallback`` (the
+    ``--threshold`` flag). Returns ``metric -> float``."""
+    doc = thresholds or {}
+    rung_doc = (doc.get("rungs") or {}).get(rung) or {}
+    metrics = rung_doc.get("metrics") or {}
+
+    def budget(metric):
+        for candidate in (metrics.get(metric), rung_doc.get("default"),
+                          doc.get("default")):
+            if candidate is not None:
+                return float(candidate)
+        return float(fallback)
+    return budget
 
 
 def render_compare(rows, label_a="A", label_b="B"):
@@ -205,9 +233,12 @@ def render_compare(rows, label_a="A", label_b="B"):
     return _table(["metric", label_a, label_b, "delta", "pct", ""], table_rows)
 
 
-def render_diff(doc_a, doc_b, label_a, label_b, rung=None, threshold=0.05):
+def render_diff(doc_a, doc_b, label_a, label_b, rung=None, threshold=0.05,
+                thresholds=None):
     """Compare two BENCH_PERF.json artifacts per rung. Returns
-    (report text, regressed flag)."""
+    (report text, regressed flag). ``thresholds`` is an optional
+    per-rung/per-metric budget document (see :func:`threshold_resolver`);
+    ``threshold`` is the global fallback."""
     snaps_a = doc_a.get("snapshots") or {}
     snaps_b = doc_b.get("snapshots") or {}
     rungs = sorted(set(snaps_a) & set(snaps_b))
@@ -217,10 +248,14 @@ def render_diff(doc_a, doc_b, label_a, label_b, rung=None, threshold=0.05):
         rungs = [rung]
     out, regressed = [], False
     for r in rungs:
+        budget = threshold_resolver(thresholds, r, threshold)
         rows = diff_rows(snapshot_headline(snaps_a[r]), snapshot_headline(snaps_b[r]),
-                         threshold)
+                         budget)
         regressed = regressed or any(row["regressed"] for row in rows)
-        out.append(f"== {r} ==  ({label_a} -> {label_b}, threshold {100.0 * threshold:.0f}%)")
+        budgets = sorted({row["budget"] for row in rows})
+        label = (f"{100.0 * budgets[0]:.0f}%" if len(budgets) == 1
+                 else "per-metric")
+        out.append(f"== {r} ==  ({label_a} -> {label_b}, threshold {label})")
         out.append(render_compare(rows, label_a=label_a, label_b=label_b))
     only_a = sorted(set(snaps_a) - set(snaps_b))
     only_b = sorted(set(snaps_b) - set(snaps_a))
@@ -244,6 +279,10 @@ def main(argv=None):
                          "a regression beyond --threshold")
     ap.add_argument("--threshold", type=float, default=0.05,
                     help="relative regression threshold for --diff (default 0.05)")
+    ap.add_argument("--thresholds", metavar="JSON", default=None,
+                    help="per-rung/per-metric budget file for --diff "
+                         "(e.g. tools/perf_thresholds.json); --threshold "
+                         "remains the fallback for unlisted entries")
     args = ap.parse_args(argv)
     if args.diff is not None:
         path_a, path_b = args.diff
@@ -252,13 +291,18 @@ def main(argv=None):
                 doc_a = json.load(f)
             with open(path_b) as f:
                 doc_b = json.load(f)
+            thresholds = None
+            if args.thresholds:
+                with open(args.thresholds) as f:
+                    thresholds = json.load(f)
         except OSError as e:
             print(f"perf_report: cannot read diff input: {e}", file=sys.stderr)
             return 1
         try:
             text, regressed = render_diff(doc_a, doc_b,
                                           os.path.basename(path_a), os.path.basename(path_b),
-                                          rung=args.rung, threshold=args.threshold)
+                                          rung=args.rung, threshold=args.threshold,
+                                          thresholds=thresholds)
         except KeyError as e:
             print(f"perf_report: {e.args[0]}", file=sys.stderr)
             return 1
