@@ -186,6 +186,23 @@ RUNG_CONTRACTS = {
                       "ledger's readmit_saved_prefill_flops",
         "baseline_tokens_per_sec_chip": 25000.0,
     },
+    "serve_tp": {
+        "model": "cpu: tiny-cyclic vocab64 L2 H4 KVH2 d32 fp32 (param seed 0) on 2 forced "
+                 "host devices; tpu: gpt2-124M bf16 on 2 chips",
+        "measure": "fused serving tokens/s at tensor_parallel=2 (heads/MLP/KV-pool sharded "
+                   "over the 'tensor' mesh axis, explicit per-layer allreduces) vs the tp=1 "
+                   "single-chip engine on the identical workload; dispatches and analytic "
+                   "allreduce bytes reported beside",
+        "workload": "cpu: 4 requests, prompt 8..24, 16 new tokens; "
+                    "tpu: 32 requests, prompt 64..128, 64 new tokens",
+        "acceptance": "tp=2 greedy output token-identical to tp=1; per-shard paged-KV bytes "
+                      "exactly 1/2 of the global pool; tp=1 counts zero allreduce bytes",
+        "accounting": "allreduce bytes = tokens x d_model x 2 reduces x layers x element "
+                      "size (DS_TPU_TP_ALLREDUCE_BITS-aware) — the overlap/quantization "
+                      "seam's denominator; same HBM-bound 25k tok/s/chip denominator as "
+                      "serve on TPU, where tp=2 halves the per-chip weight sweep",
+        "baseline_tokens_per_sec_chip": 25000.0,
+    },
     "attn": {
         "shape": "B2 S4096 H32 KVH4 D128 causal, full fwd+bwd (grads wrt q,k,v)",
         "measure": "useful TF/s of the winning attention impl",
@@ -217,6 +234,7 @@ FROZEN_HASHES = {
     "serve_spec": "ae338fc499ea08e2",
     "serve_sla": "4ef79dd1d8c8501c",
     "serve_kvtier": "9d97f11154f13048",
+    "serve_tp": "f87948c1721ab105",
     "attn": "779084b20083fd56",
     "attn_d64": "73ea8908662973d7",
     "longctx": "d12d5cc4417623bf",
@@ -738,6 +756,104 @@ def run_serve_kvtier(jax, jnp, np, cfg_model, platform):
     }
 
 
+def run_serve_tp(jax, jnp, np, cfg_model, platform):
+    """Tensor-parallel serving rung (contract: RUNG_CONTRACTS['serve_tp'];
+    docs/SERVING.md "Tensor-parallel serving").
+
+    The same fused workload is served at tp=1 (the existing single-chip
+    engine) and tp=2 (heads/MLP/KV-pool sharded over the ``tensor`` mesh
+    axis, explicit per-layer allreduces). Greedy token parity between the
+    two IS the correctness contract; the headline is tp=2 tokens/s with
+    dispatch counts and the analytic allreduce traffic reported beside,
+    plus the per-shard KV-pool byte check (each device holds 1/2 of every
+    block)."""
+    from deepspeed_tpu.inference.v2 import (InferenceEngineV2, RaggedBatchConfig,
+                                            RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import CausalLM, TransformerConfig
+    from deepspeed_tpu.parallel.mesh import reset_mesh
+    from deepspeed_tpu.telemetry import (get_event_log, get_registry,
+                                         latency_summary)
+
+    if jax.device_count() < 2:
+        raise RuntimeError(
+            f"serve_tp needs >=2 local devices, found {jax.device_count()} — on "
+            "host backends set XLA_FLAGS=--xla_force_host_platform_device_count=2 "
+            "(bench main() does this when the rung is selected up front)")
+    if platform == "tpu":
+        n_req, tlo, thi, new_toks, kv_bs, dtype = 32, 64, 128, 64, 128, "bf16"
+    else:
+        # the serve_spec/serve_kvtier tiny-cyclic model (param seed 0):
+        # H4/KVH2 divide by tp=2 and fp32 keeps the parity check exact
+        cfg_model = TransformerConfig(vocab_size=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                                      d_model=32, max_seq_len=512, norm="rmsnorm",
+                                      activation="swiglu", pos_emb="rope", tie_embeddings=False)
+        n_req, tlo, thi, new_toks, kv_bs, dtype = 4, 8, 24, 16, 8, "float32"
+    model = CausalLM(cfg_model)
+    params = model.init(jax.random.PRNGKey(0), {"input_ids": np.zeros((1, 8), np.int32)})
+    max_ctx = min(cfg_model.max_seq_len, thi + new_toks + kv_bs)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(1, cfg_model.vocab_size, size=int(l)).tolist()
+               for l in rng.randint(tlo, thi + 1, size=n_req)]
+    reg = get_registry()
+    c_disp = reg.counter("infer_dispatches_total")
+    c_tp_bytes = reg.counter("infer_tp_allreduce_bytes_total")
+
+    def run(tp):
+        reset_mesh()
+        smc = RaggedBatchConfig(max_context=max_ctx, kv_block_size=kv_bs)
+        smc.num_kv_blocks = n_req * (-(-max_ctx // kv_bs)) + 8
+        # prefix cache off: the timed wave must recompute, not re-serve
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            state_manager=smc, dtype=dtype, tensor_parallel=tp,
+            enable_prefix_cache=False))
+        eng.generate(prompts, max_new_tokens=new_toks)  # compile every shape
+        acct = _perf_begin()
+        d0, b0 = c_disp.value, c_tp_bytes.value
+        events = get_event_log()
+        events.clear()
+        t0 = time.perf_counter()
+        out = eng.generate(prompts, max_new_tokens=new_toks)
+        dt = time.perf_counter() - t0
+        lat = latency_summary(events.events())
+        assert all(len(o) == new_toks for o in out)
+        kv_shard_frac = None
+        if tp > 1:
+            shard = eng.k_pages.addressable_shards[0].data
+            kv_shard_frac = shard.nbytes / eng.k_pages.nbytes
+        return {
+            "out": out, "tps": n_req * new_toks / dt, "lat": lat,
+            "dispatches": int(c_disp.value - d0),
+            "allreduce_bytes": int(c_tp_bytes.value - b0),
+            "kv_shard_frac": kv_shard_frac,
+            # tp=1 writes first, tp=2 (the headline run) overwrites
+            "perf": _perf_extras("serve_tp", acct, dt),
+        }
+
+    tp1 = run(1)
+    tp2 = run(2)
+    # token-for-token greedy parity tp=2 vs tp=1 IS the correctness
+    # contract — a bench reporting speed from divergent outputs would be
+    # measuring a different computation
+    assert tp2["out"] == tp1["out"], "tp=2 changed greedy output vs tp=1"
+    assert tp1["allreduce_bytes"] == 0, "tp=1 engine counted allreduce traffic"
+    assert tp2["allreduce_bytes"] > 0, "tp=2 engine counted no allreduce traffic"
+    assert abs(tp2["kv_shard_frac"] - 0.5) < 1e-9, \
+        f"per-shard KV bytes {tp2['kv_shard_frac']:.3f} of global, expected 1/2"
+    _EVENT_LATENCY["serve_tp"] = tp2["lat"]
+    return tp2["tps"], {
+        "tp_degree": 2,
+        "tp_parity": True,
+        "kv_bytes_per_shard_frac": round(tp2["kv_shard_frac"], 4),
+        "dispatches": tp2["dispatches"],
+        "dispatches_tp1": tp1["dispatches"],
+        "allreduce_bytes": tp2["allreduce_bytes"],
+        "tokens_per_sec_tp1": round(tp1["tps"], 1),
+        "tp_speedup": round(tp2["tps"] / max(1e-9, tp1["tps"]), 3),
+        "ttft_p50_s": tp2["lat"]["ttft_p50_s"], "tpot_p50_s": tp2["lat"]["tpot_p50_s"],
+        **tp2["perf"],
+    }
+
+
 def _probe_backend(timeout_s: float = 180.0):
     """Initialize the jax backend under a watchdog (shared protocol:
     ``deepspeed_tpu/utils/watchdog.py``): a wedged TPU tunnel makes the
@@ -920,6 +1036,20 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
             "vs_baseline": round(tps / baseline, 4) if platform == "tpu" else None,
             **extra,
         }
+    if rung == "serve_tp":
+        tps, extra = run_serve_tp(jax, jnp, np, cfg_model, platform)
+        baseline = RUNG_CONTRACTS["serve_tp"]["baseline_tokens_per_sec_chip"]
+        return {
+            "metric": f"gpt2-125m_bf16_serve_tp2_tokens_per_sec_per_chip{tag}"
+            if platform == "tpu" else f"tiny_cyclic_serve_tp2_tokens_per_sec{tag}",
+            "value": round(tps, 1),
+            "unit": "tokens/s/chip",
+            # like serve_spec/serve_kvtier: the HBM-bound denominator only
+            # means something on TPU; the CPU row's signal is tp_parity and
+            # the dispatch/allreduce-byte deltas
+            "vs_baseline": round(tps / baseline, 4) if platform == "tpu" else None,
+            **extra,
+        }
     if rung == "serve_sla":
         eff, rows = run_serve_sla(jax, jnp, np, cfg_model, platform)
         baseline = RUNG_CONTRACTS["serve_sla"]["baseline_tokens_per_sec_chip"]
@@ -997,10 +1127,16 @@ def _rung_result(rung, deepspeed_tpu, jax, jnp, np, cfg_model, platform, n_dev, 
 def main():
     rung = os.environ.get("DS_BENCH_RUNG", "zero2").lower()
     known = ("zero2", "zero3", "decode", "serve", "serve_prefix", "serve_spec", "serve_sla",
-             "serve_kvtier", "attn", "attn_d64", "longctx")
+             "serve_kvtier", "serve_tp", "attn", "attn_d64", "longctx")
     if rung not in known:
         print(f"[bench] unknown DS_BENCH_RUNG {rung!r}: expected {' | '.join(known)}", file=sys.stderr)
         return 1
+    if rung == "serve_tp" and "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        # the tp=2 A/B needs >=2 local devices; host backends must be told
+        # BEFORE jax initializes in _probe_backend (real TPUs ignore this)
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "") +
+                                   " --xla_force_host_platform_device_count=2").strip()
     # bench opts into mode 2 (AOT XLA cost/memory analysis): the extra
     # compile per program signature lands in warmup, outside every timed
     # window; an explicit DS_TPU_PERF_ACCOUNT in the env still wins

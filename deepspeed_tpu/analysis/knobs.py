@@ -220,6 +220,16 @@ declare("DS_TPU_PROGRAM_CACHE", "8", "int",
         "Max live compiled variants per serving program family (fused step, "
         "decode burst, spec verify) before LRU eviction.",
         "inference/v2/engine_v2.py")
+declare("DS_TPU_TP", "0", "int",
+        "Tensor-parallel degree for serving: shard attention heads, MLP "
+        "hidden dims and the paged KV pool over a 'tensor' mesh axis of "
+        "this many local devices (0/1 = off; explicit engine config wins).",
+        "inference/v2/engine_v2.py")
+declare("DS_TPU_TP_ALLREDUCE_BITS", "0", "int",
+        "Quantized TP activation allreduce: 8 or 4 runs the two per-layer "
+        "row-parallel reduces as an EQuARX-style shared-scale integer-code "
+        "psum at that width (0 = exact full-precision reduce).",
+        "comm/collectives.py")
 
 # Closed-loop autotuning (autotune/, docs/OBSERVABILITY.md "Closing the loop")
 declare("DS_TPU_TUNED_PROFILE", None, "str",

@@ -56,6 +56,45 @@ def inference_all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, group: AxisName = 
     return _psum_like(tensor, group, op)
 
 
+def _tp_reduce_chunk(x, group: AxisName, bits: int):
+    if bits <= 0:
+        return lax.psum(x, group)
+    # EQuARX-style quantized allreduce: shards agree on a shared per-row
+    # scale (pmax of local amax), psum the integer codes exactly, then
+    # rescale. Integer summation is associative, so the result is
+    # bit-identical regardless of reduction order, and the per-element
+    # error is bounded by tp * scale / 2 (each shard's rounding error is
+    # at most scale/2). A real TPU build would fuse this into the XLA
+    # allreduce; here we emulate the semantics and account bytes at
+    # bits/8 per element.
+    qmax = (1 << (bits - 1)) - 1
+    xf = x.astype(jnp.float32)
+    amax = lax.pmax(jnp.max(jnp.abs(xf), axis=-1, keepdims=True), group)
+    scale = jnp.maximum(amax, 1e-30) / qmax
+    codes = jnp.clip(jnp.round(xf / scale), -qmax, qmax).astype(jnp.int32)
+    return (lax.psum(codes, group).astype(jnp.float32) * scale).astype(x.dtype)
+
+
+def tp_all_reduce(tensor, group: AxisName = "tensor", bits: int = 0, interleave: int = 1):
+    """Row-parallel activation allreduce for TP serving (o_proj / down_proj).
+
+    ``bits > 0`` selects the EQuARX-style quantized reduce (shared scale +
+    exact integer-code psum). ``interleave > 1`` splits the hidden dim into
+    that many independently-reduced chunks, issuing one collective per
+    chunk — the T3-style overlap seam: each chunk's psum is independent of
+    the others, so a scheduler that overlaps collectives with the next
+    matmul's shards can start it as soon as its slice of the producing
+    matmul finishes (XLA only partially exploits this on CPU, but the
+    program structure is the one T3 wants). Chunking never changes the
+    result: each element is reduced exactly once either way.
+    """
+    _audit("tp_all_reduce", tensor, group)
+    if interleave > 1 and tensor.shape[-1] % interleave == 0:
+        chunks = jnp.split(tensor, interleave, axis=-1)
+        return jnp.concatenate([_tp_reduce_chunk(c, group, bits) for c in chunks], axis=-1)
+    return _tp_reduce_chunk(tensor, group, bits)
+
+
 def all_gather_into_tensor(tensor, group: AxisName = "data", axis: int = 0, tiled: bool = True):
     """Gather shards along ``axis`` from every member; result is the
     concatenation (``tiled=True``, torch semantics) or stacked (False)."""

@@ -37,8 +37,8 @@ from ...telemetry.journal import get_journal
 from ...telemetry.ops_plane import maybe_start_ops_server
 from ...utils.logging import log_dist, logger
 from ...ops.pallas.paged_attention import make_kv_pool
-from .model_runner import (make_burst_fn, make_fused_step_fn, make_spec_verify_fn,
-                           make_step_fns)
+from .model_runner import (TPContext, make_burst_fn, make_fused_step_fn,
+                           make_spec_verify_fn, make_step_fns)
 from .ragged.manager import DSStateManager, RaggedBatchConfig
 from .scheduler import FusedQuantum, RaggedBatchScheduler, RaggedRequest
 from .spec import make_drafter
@@ -127,13 +127,21 @@ class InferenceEngineV2:
         self.dtype = jnp.bfloat16 if config.dtype in ("bfloat16", "bf16") else jnp.float32
 
         self._tp = int(config.tensor_parallel)
+        if self._tp <= 1:
+            # DS_TPU_TP applies only when the config left TP at the default:
+            # an explicit config (replay rebuilding a recorded engine, tests
+            # pinning a degree) always wins over the environment
+            self._tp = max(1, knobs.get_int("DS_TPU_TP") or 1)
+            config.tensor_parallel = self._tp
+        tp_bits = knobs.get_int("DS_TPU_TP_ALLREDUCE_BITS")
+        if tp_bits not in (0, 4, 8):
+            raise ValueError(f"DS_TPU_TP_ALLREDUCE_BITS must be 0, 4 or 8, got {tp_bits}")
+        self._tp_bits = int(tp_bits)
         self._mesh_topo = None
         if self._tp > 1:
-            from ...parallel.mesh import MeshTopology, initialize_mesh
-            from ...runtime.config import MeshConfig
+            from ...parallel.mesh import MeshTopology, serving_mesh
 
-            self._mesh_topo = mesh if isinstance(mesh, MeshTopology) else \
-                initialize_mesh(MeshConfig.from_dict({"data": -1, "tensor": self._tp}))
+            self._mesh_topo = mesh if isinstance(mesh, MeshTopology) else serving_mesh(self._tp)
             if self._mesh_topo.model_parallel_size != self._tp:
                 raise ValueError(f"mesh tensor axis {self._mesh_topo.model_parallel_size} != "
                                  f"tensor_parallel {self._tp}")
@@ -193,7 +201,8 @@ class InferenceEngineV2:
         self.scheduler = RaggedBatchScheduler(self.state,
                                               max_batch_tokens=int(quantum_tokens),
                                               max_sequences=smc.max_ragged_sequence_count,
-                                              prefill_chunk=knobs.get_int("DS_TPU_PREFILL_CHUNK"))
+                                              prefill_chunk=knobs.get_int("DS_TPU_PREFILL_CHUNK"),
+                                              shard_degree=self._tp)
 
         # --- telemetry (docs/OBSERVABILITY.md) ---
         tele = get_telemetry_registry()
@@ -208,6 +217,11 @@ class InferenceEngineV2:
         self._m_dispatches = tele.counter("infer_dispatches_total")
         self._m_fused_quanta = tele.counter("infer_fused_quanta_total")
         self._m_fused_fill = tele.gauge("infer_fused_batch_fill")
+        # tensor-parallel serving (docs/SERVING.md "Tensor-parallel
+        # serving"): degree gauge + analytic allreduce traffic counter
+        self._m_tp_degree = tele.gauge("tp_degree")
+        self._m_tp_degree.set(float(self._tp))
+        self._m_tp_bytes = tele.counter("infer_tp_allreduce_bytes_total")
         # speculative decoding: draft/accept accounting (the rollback
         # counter lives in the state manager next to the block bookkeeping)
         self._m_spec_proposed = tele.counter("spec_tokens_proposed_total")
@@ -272,15 +286,40 @@ class InferenceEngineV2:
 
         cast = lambda x: x.astype(self.dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x
         self.params = jax.tree_util.tree_map(cast, params)
+        self._tp_ctx = None
         if self._tp > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
 
             from ...module_inject.load_checkpoint import shard_params
 
             self.params = shard_params(self.params, self.model, mesh=self._mesh_topo, tp_size=self._tp)
-            page_sharding = NamedSharding(self._mesh_topo.mesh, P(None, None, None, "tensor", None))
-            self.k_pages = jax.device_put(self.k_pages, page_sharding)
-            self.v_pages = jax.device_put(self.v_pages, page_sharding)
+            from ...ops.pallas.paged_attention import shard_kv_pool
+            self.k_pages = shard_kv_pool(self.k_pages, self._mesh_topo.mesh)
+            self.v_pages = shard_kv_pool(self.v_pages, self._mesh_topo.mesh)
+            if not config.quant_bits:
+                # explicit-collective TP: the per-layer stack runs in ONE
+                # shard_map region with tp_all_reduce seams (T3 interleave +
+                # optional EQuARX-quantized psum). Weight-only-quantized
+                # engines keep the GSPMD path: their matmuls lower through a
+                # custom_partitioning that cannot run under manual sharding.
+                layer_params = {k: v for k, v in self.params.items()
+                                if k.startswith("layer_")}
+                specs = jax.tree_util.tree_map(
+                    lambda a: getattr(getattr(a, "sharding", None), "spec", P()),
+                    layer_params)
+                self._tp_ctx = TPContext(mesh=self._mesh_topo.mesh, tp=self._tp,
+                                         bits=self._tp_bits, interleave=self._tp,
+                                         param_specs=specs)
+        # sharding signature: part of every program-cache key and of the
+        # journal fingerprint — toggling TP (or the allreduce mode) can
+        # never hit a stale compiled program or replay across topologies
+        if self._tp_ctx is not None:
+            self._shard_sig = self._tp_ctx.signature()
+        elif self._tp > 1:
+            from ...parallel.mesh import mesh_signature
+            self._shard_sig = f"tp{self._tp}:gspmd:{mesh_signature(self._mesh_topo)}"
+        else:
+            self._shard_sig = "tp1"
         if config.quant_bits:
             # quantize AFTER sharding (the reference's order, GroupQuantizer
             # post-mp-shard in module_inject/replace_module.py:43): K-groups
@@ -295,7 +334,8 @@ class InferenceEngineV2:
             from ...ops.registry import pallas_available
             interpret = not pallas_available()
         run_mesh = self._mesh_topo.mesh if self._mesh_topo is not None else None
-        self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh, tp=self._tp)
+        self._prefill_fn, self._decode_fn = make_step_fns(run_cfg, interpret=interpret, mesh=run_mesh,
+                                                          tp=self._tp, tp_ctx=self._tp_ctx)
         self._run_cfg, self._interpret, self._run_mesh = run_cfg, interpret, run_mesh
         # the accountant wraps the RAW jitted programs (innermost), so cost
         # cards trace/AOT-analyze the real executable; the JitAuditor wraps
@@ -354,13 +394,14 @@ class InferenceEngineV2:
         signature evicted (its executables free with the jit wrapper)."""
         if self._config.decode_burst < 2:
             return None
-        key = sampling or (False, 1.0, 0, 1.0)
+        key = (sampling or (False, 1.0, 0, 1.0)) + (self._shard_sig,)
         if key not in self._bursts:
             if len(self._bursts) >= getattr(self, "_max_program_variants", self._MAX_BURST_VARIANTS):
                 self._bursts.pop(next(iter(self._bursts)))
-            do, t, k, p = key
+            do, t, k, p = key[:4]
             fn = make_burst_fn(self._run_cfg, interpret=self._interpret, mesh=self._run_mesh,
-                               tp=self._tp, do_sample=do, temperature=t, top_k=k, top_p=p)
+                               tp=self._tp, tp_ctx=self._tp_ctx,
+                               do_sample=do, temperature=t, top_k=k, top_p=p)
             fn = self._acct.wrap(f"burst{key}", fn)
             if self.jit_auditor is not None:
                 fn = self.jit_auditor.wrap(f"burst{key}", fn)
@@ -370,6 +411,18 @@ class InferenceEngineV2:
             # evicted by a frontend cycling through >8 sampling configs
             self._bursts[key] = self._bursts.pop(key)
         return self._bursts[key]
+
+    def _account_tp_allreduce(self, tokens: int) -> None:
+        """Analytic TP-collective traffic for one dispatch: every padded
+        token crosses the two per-layer row-parallel reduces (post-attention
+        and post-MLP), each moving d_model elements per layer — at the
+        quantized width when the EQuARX reduce is on, else at the activation
+        dtype. Pure host arithmetic; zero when tp=1."""
+        if self._tp <= 1 or tokens <= 0:
+            return
+        nbits = self._tp_bits if (self._tp_bits and self._tp_ctx is not None) \
+            else jnp.dtype(self.dtype).itemsize * 8
+        self._m_tp_bytes.inc(tokens * self.cfg.d_model * 2 * self.cfg.n_layers * nbits // 8)
 
     def _choose_tokens_dev(self, logits):
         """Device-side token choice for (n, V) logits: argmax, or the shared
@@ -493,6 +546,10 @@ class InferenceEngineV2:
         ``(codes, scales)`` pytree — a COW'd quantized block copies its
         scale plane with its codes, so dequant stays exact."""
         if self._cow_fn is None:
+            # page-copy sharding note: the program specializes on the donated
+            # pools' shardings (GSPMD keeps the head axis split under TP), and
+            # the cache is per-engine — toggling TP builds a new engine, so a
+            # stale single-chip copy program is unreachable by construction
             copy_at = lambda pool, s, d: jax.tree_util.tree_map(
                 lambda p: p.at[:, d].set(p[:, s]), pool)
             self._cow_fn = jax.jit(
@@ -609,6 +666,7 @@ class InferenceEngineV2:
         for seq in seqs:
             seq.post_forward()
         useful = sum(len(t) for t in token_lists)
+        self._account_tp_allreduce(B * S)
         if defer:
             out_dev = self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
             self._acct.attribute(useful, B * S)
@@ -691,6 +749,7 @@ class InferenceEngineV2:
                 self._events.emit("decode", uid, q=q, k=1)
         for seq in seqs:
             seq.post_forward()
+        self._account_tp_allreduce(len(ctx))
         if defer:
             out_dev = self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
             self._acct.attribute(n, len(ctx))
@@ -748,6 +807,7 @@ class InferenceEngineV2:
             journal.record_quantum(q, uids, [], steps=steps)
         for seq in seqs:
             seq.post_forward()
+        self._account_tp_allreduce(len(ctx) * steps)
         if defer:
             self._acct.attribute(n * steps, len(ctx) * steps)
             return toks[:n]  # device (n, steps), no readback
@@ -783,13 +843,13 @@ class InferenceEngineV2:
         wrapper, so eviction frees the compiled executables). The burst
         step count is NOT part of the key: it rides the follow-on slot
         table's leading dim, so one wrapper serves the whole ladder."""
-        key = (n_dec, n_pre, chunk) + (sampling or (False, 1.0, 0, 1.0))
+        key = (n_dec, n_pre, chunk) + (sampling or (False, 1.0, 0, 1.0)) + (self._shard_sig,)
         if key not in self._fused_fns:
             if len(self._fused_fns) >= getattr(self, "_max_program_variants", self._MAX_FUSED_VARIANTS):
                 self._fused_fns.pop(next(iter(self._fused_fns)))
-            do, t, k, p = key[3:]
+            do, t, k, p = key[3:7]
             fn = make_fused_step_fn(self._run_cfg, interpret=self._interpret,
-                                    mesh=self._run_mesh, tp=self._tp,
+                                    mesh=self._run_mesh, tp=self._tp, tp_ctx=self._tp_ctx,
                                     n_dec=n_dec, n_pre=n_pre, chunk=chunk,
                                     do_sample=do, temperature=t, top_k=k, top_p=p)
             fn = self._acct.wrap(f"fused{key}", fn)
@@ -921,6 +981,7 @@ class InferenceEngineV2:
         self._m_fused_quanta.inc()
         real = n_dec * steps + sum(len(p.tokens) for p in prefills)
         self._m_fused_fill.set(real / max(1, D * steps + P * S))
+        self._account_tp_allreduce(D * steps + P * S)
         if self._events.enabled and dec_uids:
             q = self.scheduler.last_quantum_id
             for uid in dec_uids:
@@ -955,13 +1016,14 @@ class InferenceEngineV2:
         length, sampling signature) — same eviction discipline as
         ``_burst_for``/``_fused_for``. The padded row count rides jit's
         shape specialization; only the verify window is static."""
-        key = (chunk,) + (sampling or (False, 1.0, 0, 1.0))
+        key = (chunk,) + (sampling or (False, 1.0, 0, 1.0)) + (self._shard_sig,)
         if key not in self._spec_fns:
             if len(self._spec_fns) >= getattr(self, "_max_program_variants", self._MAX_SPEC_VARIANTS):
                 self._spec_fns.pop(next(iter(self._spec_fns)))
-            do, t, k, p = key[1:]
+            do, t, k, p = key[1:5]
             fn = make_spec_verify_fn(self._run_cfg, interpret=self._interpret,
-                                     mesh=self._run_mesh, tp=self._tp, chunk=chunk,
+                                     mesh=self._run_mesh, tp=self._tp, tp_ctx=self._tp_ctx,
+                                     chunk=chunk,
                                      do_sample=do, temperature=t, top_k=k, top_p=p)
             fn = self._acct.wrap(f"spec{key}", fn)
             if self.jit_auditor is not None:
@@ -1071,6 +1133,7 @@ class InferenceEngineV2:
         # useful = committed tokens (carry + accepted drafts); slots = the
         # whole padded verify window the program actually computed
         self._acct.attribute(n + total_acc, B * chunk)
+        self._account_tp_allreduce(B * chunk)
         self._acct.note_spec(total_prop, total_acc)
         self._m_decode_tokens.inc(n + total_acc)
         self._m_spec_proposed.inc(total_prop)
@@ -1142,7 +1205,7 @@ class InferenceEngineV2:
         """Compiled-program cache signatures at this instant — part of the
         journal fingerprint (a replay that compiles a different program
         set is suspect before a single token diverges)."""
-        sigs = ["prefill", "decode"]
+        sigs = [f"prefill:{self._shard_sig}", f"decode:{self._shard_sig}"]
         sigs += [f"burst{k}" for k in self._bursts]
         sigs += [f"fused{k}" for k in self._fused_fns]
         sigs += [f"spec{k}" for k in self._spec_fns]
@@ -1152,6 +1215,7 @@ class InferenceEngineV2:
         """Everything the replay harness needs to rebuild this engine:
         model config, resolved engine geometry/loop flags, the knob
         registry as resolved, and the program-cache signatures."""
+        from ...parallel.mesh import mesh_signature
         from ...telemetry.flight import resolved_knobs
 
         smc = self._config.state_manager
@@ -1170,6 +1234,9 @@ class InferenceEngineV2:
                 "kv_spill": self._kv_spill,
                 "enable_prefix_cache": self.state.prefix_cache is not None,
                 "tensor_parallel": self._tp,
+                "tp_allreduce_bits": self._tp_bits,
+                "shard_sig": self._shard_sig,
+                "mesh": mesh_signature(self._mesh_topo) if self._mesh_topo is not None else "mesh[none]",
                 "num_kv_blocks": self._n_kv_blocks,
                 "kv_block_size": smc.kv_block_size,
                 "max_context": smc.max_context,
@@ -1197,6 +1264,11 @@ class InferenceEngineV2:
             "kv_blocks_total": self._n_kv_blocks,
             "kv_blocks_free": int(self.state.free_blocks),
             "block_bytes": int(self._block_bytes),
+            # per-shard view: KV heads split over the tensor axis, so each
+            # chip holds 1/tp of every block's bytes (block tables replicated)
+            "tp_degree": int(self._tp),
+            "block_bytes_per_shard": int(self.state.shard_geometry(
+                self._block_bytes, self._tp)["block_bytes_per_shard"]),
             "kv_quant_bits": int(self._kv_quant_bits),
             "prefix_cached_blocks": int(pc.cached_blocks) if pc is not None else 0,
             "host_tier_bytes": int(pc.host_tier_bytes) if pc is not None else 0,

@@ -24,6 +24,7 @@ from the params' shardings, and the Pallas decode kernel runs under
 is embarrassingly parallel over heads).
 """
 
+import dataclasses
 import functools
 from typing import Any, Dict, Optional, Tuple
 
@@ -40,6 +41,7 @@ except ImportError:  # pragma: no cover — older jax: experimental namespace
     MODERN_SHARD_MAP = False
 from jax.sharding import PartitionSpec as P
 
+from ...comm.collectives import tp_all_reduce
 from ...models.transformer import (TransformerConfig, alibi_slopes, apply_rope, scaled_rope_frequencies)
 from ...ops.pallas.paged_attention import (kv_layer, kv_set_layer, paged_attention_decode,
                                            paged_attention_mixed, paged_attention_prefill,
@@ -53,9 +55,41 @@ def _is_moe_layer(cfg: TransformerConfig, i: int) -> bool:
     return cfg.moe_num_experts > 0 and (i % freq == freq - 1)
 
 
-def _attn_fn_builder(cfg: TransformerConfig, interpret: bool, mesh, tp: int):
+@dataclasses.dataclass(frozen=True)
+class TPContext:
+    """Explicit-collective tensor-parallel execution context.
+
+    When set, the per-layer stack of every serving forward runs inside one
+    ``shard_map`` region over ``axis``: attention heads / MLP hidden dims
+    arrive pre-sharded (the params' GSPMD shardings, mirrored in
+    ``param_specs``), the paged KV pool is sharded over its KV-head dim,
+    block tables / token operands are replicated, and the two row-parallel
+    partial sums per layer go through ``comm.collectives.tp_all_reduce``
+    (optionally quantized / chunk-interleaved). Embedding and unembed stay
+    outside the region under plain GSPMD — the vocab-sharded gather and
+    head projection are exactly what XLA already handles well.
+    """
+
+    mesh: Any                 # jax.sharding.Mesh
+    tp: int
+    axis: str = "tensor"
+    bits: int = 0             # DS_TPU_TP_ALLREDUCE_BITS (0 = full precision)
+    interleave: int = 1       # chunks per activation allreduce (T3 seam)
+    param_specs: Any = None   # PartitionSpec pytree over the layer_* subtree
+
+    def signature(self) -> str:
+        """Cache-key / fingerprint identity of this sharded program class."""
+        axes = ",".join(f"{a}{s}" for a, s in
+                        zip(self.mesh.axis_names, self.mesh.devices.shape) if s > 1)
+        return f"tp{self.tp}:{self.axis}:b{self.bits}:il{self.interleave}:mesh[{axes}]"
+
+
+def _attn_fn_builder(cfg: TransformerConfig, interpret: bool, mesh, tp: int, slopes=None):
     """window -> (decode_attn, prefill_attn, native) — shared by the ragged
-    and fused forwards so both hot paths bake identical kernel variants."""
+    and fused forwards so both hot paths bake identical kernel variants.
+    ``slopes`` overrides the baked ALiBi slopes (the manual-TP stack bakes
+    each shard's dynamic slice; tracer-valued slopes are legal in the
+    kernels)."""
     H = cfg.n_heads
     if mesh is not None and tp > 1:
         # heads split over `tensor`: each shard decodes its own heads
@@ -72,7 +106,8 @@ def _attn_fn_builder(cfg: TransformerConfig, interpret: bool, mesh, tp: int):
     # (gpt-neo alternates global/local; qwen2 windows a layer suffix) —
     # the layer loop is unrolled, so windows are static per layer and
     # each value bakes its own kernel variant
-    _slopes = alibi_slopes(H) if cfg.pos_emb == "alibi" else None
+    _slopes = slopes if slopes is not None else (
+        alibi_slopes(H) if cfg.pos_emb == "alibi" else None)
     _fns = {}
 
     def attn_fns(window):
@@ -93,14 +128,18 @@ def _attn_fn_builder(cfg: TransformerConfig, interpret: bool, mesh, tp: int):
 
 def _transformer_layer(cfg: TransformerConfig, lp: Dict, x: jnp.ndarray, k_pages_i: jnp.ndarray,
                        v_pages_i: jnp.ndarray, slot_mapping: jnp.ndarray, cos, sin, positions: jnp.ndarray,
-                       attn_apply, mods, moe: bool) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                       attn_apply, mods, moe: bool, tp_reduce=None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One transformer block over (B, S) tokens against this layer's page
     pool: qkv + rope + KV page write + ``attn_apply(q, kp, vp)`` + FFN.
     The attention itself is a caller closure so the ragged (single-mode)
     and fused (mixed decode+prefill) forwards share everything else —
-    one weight read per layer regardless of how rows are batched."""
+    one weight read per layer regardless of how rows are batched.
+    ``tp_reduce`` (the manual-TP stack) sums the two row-parallel partials
+    — attention output after o_proj, FFN/MoE output after down_proj —
+    across the tensor axis; head/hidden geometry is read off the arrays,
+    so the same code runs full-size or shard-local."""
     B, S = x.shape[:2]
-    KVH, D = cfg.kv_heads, cfg.head_dim
     dtype = cfg.dtype
     h = mods.norm(cfg, _norm_p(cfg, lp, 0), x)
     q = _proj(h, lp["attn"]["q_proj"], "bsd,dhk->bshk", dtype)
@@ -116,11 +155,14 @@ def _transformer_layer(cfg: TransformerConfig, lp: Dict, x: jnp.ndarray, k_pages
         q = apply_rope(q, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
         k = apply_rope(k, cos, sin, positions, rotary_dim=cfg.rotary_dim, style=cfg.rope_style)
 
+    KVH, D = k.shape[-2], k.shape[-1]  # shard-local under manual TP
     kp, vp = update_kv_pages(k_pages_i, v_pages_i, k.reshape(B * S, KVH, D), v.reshape(B * S, KVH, D),
                              slot_mapping)
 
     attn = attn_apply(q, kp, vp)
     attn_out = _proj(attn, lp["attn"]["o_proj"], "bshk,hkd->bsd", dtype)
+    if tp_reduce is not None:
+        attn_out = tp_reduce(attn_out)
 
     if cfg.block_type == "parallel_shared":  # falcon-7b / phi / gpt-j
         ffn_in = h
@@ -130,6 +172,8 @@ def _transformer_layer(cfg: TransformerConfig, lp: Dict, x: jnp.ndarray, k_pages
         x = x + attn_out
         ffn_in = mods.norm(cfg, _norm_p(cfg, lp, 1), x)
     ffn_out = mods.moe(cfg, lp["moe"], ffn_in) if moe else mods.mlp(cfg, lp["mlp"], ffn_in)
+    if tp_reduce is not None:
+        ffn_out = tp_reduce(ffn_out)
     if cfg.block_type in ("parallel", "parallel_shared"):
         x = x + attn_out + ffn_out
     else:
@@ -137,10 +181,107 @@ def _transformer_layer(cfg: TransformerConfig, lp: Dict, x: jnp.ndarray, k_pages
     return x, kp, vp
 
 
+def _stack_body(cfg: TransformerConfig, interpret: bool, *, mixed: bool, decode: bool = False,
+                n_dec: int = 0, chunk: int = 0, mesh=None, tp: int = 1, tp_local=None):
+    """The per-layer transformer stack shared by all three serving forwards.
+
+    Returns ``body(layer_params, x, k_pages, v_pages, block_tables,
+    ctx_lens, slot_mapping, positions) -> (x, k_pages, v_pages)``.
+    ``mixed`` selects the fused decode+prefill attention
+    (``paged_attention_mixed``); otherwise the ragged single-mode module
+    routing runs with the ``decode`` flag. ``tp_local = (axis, tp, bits,
+    interleave)`` makes the body shard-local: it is then the region of a
+    ``shard_map`` over ``axis`` — per-shard ALiBi slopes are sliced by
+    ``axis_index``, head/hidden geometry is read off the (local) arrays,
+    and the two per-layer partial sums reduce through ``tp_all_reduce``.
+    ``mesh``/``tp`` are the legacy GSPMD arguments (weight-quantized TP
+    keeps that path: ``custom_partitioning`` matmuls cannot run inside a
+    manual shard_map region)."""
+    mods = build_modules()
+
+    def body(layer_params, x, k_pages, v_pages, block_tables, ctx_lens, slot_mapping, positions):
+        cos = sin = None
+        if cfg.pos_emb == "rope":
+            cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
+        # slopes feed the gather-based attention used for prefill and for
+        # the GSPMD-sharded decode; the native decode kernels bake them
+        slopes = jnp.asarray(alibi_slopes(cfg.n_heads)) if cfg.pos_emb == "alibi" else None
+        tp_reduce = None
+        if tp_local is not None:
+            axis, tp_n, bits, interleave = tp_local
+            if slopes is not None:
+                hs = cfg.n_heads // tp_n
+                slopes = jax.lax.dynamic_slice(slopes.astype(jnp.float32),
+                                               (jax.lax.axis_index(axis) * hs,), (hs,))
+            tp_reduce = functools.partial(tp_all_reduce, group=axis, bits=bits,
+                                          interleave=interleave)
+            attn_fns = _attn_fn_builder(cfg, interpret, None, 1, slopes=slopes)
+        else:
+            attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
+        flat_pos = positions[0] if mixed else None
+
+        for i in range(cfg.n_layers):
+            lp = layer_params[f"layer_{i}"]
+            w_i = cfg.window_for(i)
+            decode_attn, prefill_attn, decode_native = attn_fns(w_i)
+
+            if mixed:
+                def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
+                    out = paged_attention_mixed(q[0], kp, vp, block_tables, ctx_lens, flat_pos,
+                                                n_dec=n_dec, chunk=chunk, scale=cfg.attn_scale,
+                                                alibi_slopes=slopes, window=_w,
+                                                decode_fn=_da, prefill_fn=_pa, native=_dn)
+                    return out[None]  # (1, T, H, D)
+            else:
+                def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
+                    return mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions,
+                                          decode=decode, slopes=slopes, decode_attn=_da,
+                                          decode_native=_dn, prefill_attn=_pa, window=_w)
+
+            x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
+                                           slot_mapping, cos, sin, positions, attn_apply, mods,
+                                           _is_moe_layer(cfg, i), tp_reduce=tp_reduce)
+            k_pages = kv_set_layer(k_pages, i, kp)
+            v_pages = kv_set_layer(v_pages, i, vp)
+        return x, k_pages, v_pages
+
+    return body
+
+
+def _run_stack(cfg: TransformerConfig, params: Dict, x, k_pages, v_pages, block_tables,
+               ctx_lens, slot_mapping, positions, *, mixed: bool, decode: bool = False,
+               n_dec: int = 0, chunk: int = 0, interpret: bool = False, mesh=None,
+               tp: int = 1, tp_ctx: Optional[TPContext] = None):
+    """Run the layer stack, under ``shard_map`` when a TPContext is set.
+
+    The region covers exactly the per-layer loop: params arrive sharded
+    per their GSPMD specs, the KV pools split over their KV-head dim, and
+    every host-shaped operand (tokens already embedded into ``x``, block
+    tables, context lengths, slots, positions) is replicated. ``x`` comes
+    back replicated — the final layer's psum already made it so."""
+    layer_params = {k: v for k, v in params.items() if k.startswith("layer_")}
+    if tp_ctx is not None and tp_ctx.tp > 1:
+        body = _stack_body(cfg, interpret, mixed=mixed, decode=decode, n_dec=n_dec, chunk=chunk,
+                           tp_local=(tp_ctx.axis, tp_ctx.tp, tp_ctx.bits, tp_ctx.interleave))
+        kv_spec = P(None, None, None, tp_ctx.axis, None)
+        specs = tp_ctx.param_specs if tp_ctx.param_specs is not None else \
+            jax.tree.map(lambda _: P(), layer_params)
+        run = shard_map(body, mesh=tp_ctx.mesh,
+                        in_specs=(specs, P(), kv_spec, kv_spec, P(), P(), P(), P()),
+                        out_specs=(P(), kv_spec, kv_spec), **_SHARD_MAP_KW)
+        return run(layer_params, x, k_pages, v_pages, block_tables, ctx_lens,
+                   slot_mapping, positions)
+    body = _stack_body(cfg, interpret, mixed=mixed, decode=decode, n_dec=n_dec, chunk=chunk,
+                       mesh=mesh, tp=tp)
+    return body(layer_params, x, k_pages, v_pages, block_tables, ctx_lens,
+                slot_mapping, positions)
+
+
 def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
                    k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray, ctx_lens: jnp.ndarray,
                    slot_mapping: jnp.ndarray, last_token_idx: jnp.ndarray, *, decode: bool,
-                   interpret: bool = False, mesh=None, tp: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                   interpret: bool = False, mesh=None, tp: int = 1,
+                   tp_ctx: Optional[TPContext] = None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """One engine step over the paged cache.
 
     input_ids/positions: (B, S); k_pages/v_pages: (L, N, bs, KVH, D) — or
@@ -151,42 +292,19 @@ def ragged_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray,
     last_token_idx: (B,) index of the last real (non-pad) token per row.
     Returns (last-real-token logits (B, V), k_pages, v_pages).
     """
-    H = cfg.n_heads
-    attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
-
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids, positions)
-    cos = sin = None
-    if cfg.pos_emb == "rope":
-        cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
-    # slopes feed the gather-based attention used for prefill and for the
-    # TP-sharded decode; the single-chip decode kernel has them baked in
-    # (decode_native above)
-    slopes = jnp.asarray(alibi_slopes(H)) if cfg.pos_emb == "alibi" else None
-
-    for i in range(cfg.n_layers):
-        lp = params[f"layer_{i}"]
-        w_i = cfg.window_for(i)
-        decode_attn, prefill_attn, decode_native = attn_fns(w_i)
-
-        def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
-            return mods.attention(cfg, q, kp, vp, block_tables, ctx_lens, positions, decode=decode,
-                                  slopes=slopes, decode_attn=_da, decode_native=_dn,
-                                  prefill_attn=_pa, window=_w)
-
-        x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
-                                       slot_mapping, cos, sin, positions, attn_apply, mods,
-                                       _is_moe_layer(cfg, i))
-        k_pages = kv_set_layer(k_pages, i, kp)
-        v_pages = kv_set_layer(v_pages, i, vp)
-
+    x, k_pages, v_pages = _run_stack(cfg, params, x, k_pages, v_pages, block_tables, ctx_lens,
+                                     slot_mapping, positions, mixed=False, decode=decode,
+                                     interpret=interpret, mesh=mesh, tp=tp, tp_ctx=tp_ctx)
     return mods.unembed(cfg, params, x, last_token_idx), k_pages, v_pages
 
 
 def fused_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
                   k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray, ctx_lens: jnp.ndarray,
                   slot_mapping: jnp.ndarray, last_flat: jnp.ndarray, *, n_dec: int, chunk: int,
-                  interpret: bool = False, mesh=None, tp: int = 1) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                  interpret: bool = False, mesh=None, tp: int = 1,
+                  tp_ctx: Optional[TPContext] = None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """SplitFuse mixed step: decode rows AND chunked-prefill rows in ONE
     forward over the paged pool — every layer reads its weights once for
     the whole ragged token batch (the Dynamic SplitFuse point: prefill
@@ -200,34 +318,12 @@ def fused_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, 
     first); ``last_flat`` holds the flat index of each row's last real
     token. Returns ((N, V) fp32 next-token logits, k_pages, v_pages).
     """
-    attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
-
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids[None], positions[None])  # (1, T, d)
-    cos = sin = None
-    if cfg.pos_emb == "rope":
-        cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
-    slopes = jnp.asarray(alibi_slopes(cfg.n_heads)) if cfg.pos_emb == "alibi" else None
-    pos2d = positions[None]
-
-    for i in range(cfg.n_layers):
-        lp = params[f"layer_{i}"]
-        w_i = cfg.window_for(i)
-        decode_attn, prefill_attn, decode_native = attn_fns(w_i)
-
-        def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
-            out = paged_attention_mixed(q[0], kp, vp, block_tables, ctx_lens, positions,
-                                        n_dec=n_dec, chunk=chunk, scale=cfg.attn_scale,
-                                        alibi_slopes=slopes, window=_w,
-                                        decode_fn=_da, prefill_fn=_pa, native=_dn)
-            return out[None]  # (1, T, H, D)
-
-        x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
-                                       slot_mapping, cos, sin, pos2d, attn_apply, mods,
-                                       _is_moe_layer(cfg, i))
-        k_pages = kv_set_layer(k_pages, i, kp)
-        v_pages = kv_set_layer(v_pages, i, vp)
-
+    x, k_pages, v_pages = _run_stack(cfg, params, x, k_pages, v_pages, block_tables, ctx_lens,
+                                     slot_mapping, positions[None], mixed=True, n_dec=n_dec,
+                                     chunk=chunk, interpret=interpret, mesh=mesh, tp=tp,
+                                     tp_ctx=tp_ctx)
     # per-row last-token hidden states -> (N, 1, d) so the unembed module's
     # (batch, seq) contract holds for the ragged flat batch
     x_last = x[0, last_flat][:, None, :]
@@ -238,7 +334,8 @@ def fused_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, 
 def spec_verify_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.ndarray, positions: jnp.ndarray,
                         k_pages: jnp.ndarray, v_pages: jnp.ndarray, block_tables: jnp.ndarray,
                         ctx_lens: jnp.ndarray, slot_mapping: jnp.ndarray, *, chunk: int,
-                        interpret: bool = False, mesh=None, tp: int = 1
+                        interpret: bool = False, mesh=None, tp: int = 1,
+                        tp_ctx: Optional[TPContext] = None
                         ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Speculative-decode verify pass: every row is a ``chunk = K+1``-token
     tail (carry token + K drafts) of a live decoded sequence, run as a
@@ -249,34 +346,12 @@ def spec_verify_forward(cfg: TransformerConfig, params: Dict, input_ids: jnp.nda
     needs logits at EVERY position, so the whole flat batch unembeds:
     returns ((T, V) fp32 logits, k_pages, v_pages) with T = B * chunk.
     """
-    attn_fns = _attn_fn_builder(cfg, interpret, mesh, tp)
-
     mods = build_modules()
     x = mods.embedding(cfg, params, input_ids[None], positions[None])  # (1, T, d)
-    cos = sin = None
-    if cfg.pos_emb == "rope":
-        cos, sin = scaled_rope_frequencies(cfg, cfg.rotary_dim)
-    slopes = jnp.asarray(alibi_slopes(cfg.n_heads)) if cfg.pos_emb == "alibi" else None
-    pos2d = positions[None]
-
-    for i in range(cfg.n_layers):
-        lp = params[f"layer_{i}"]
-        w_i = cfg.window_for(i)
-        decode_attn, prefill_attn, decode_native = attn_fns(w_i)
-
-        def attn_apply(q, kp, vp, *, _w=w_i, _da=decode_attn, _pa=prefill_attn, _dn=decode_native):
-            out = paged_attention_mixed(q[0], kp, vp, block_tables, ctx_lens, positions,
-                                        n_dec=0, chunk=chunk, scale=cfg.attn_scale,
-                                        alibi_slopes=slopes, window=_w,
-                                        decode_fn=_da, prefill_fn=_pa, native=_dn)
-            return out[None]  # (1, T, H, D)
-
-        x, kp, vp = _transformer_layer(cfg, lp, x, kv_layer(k_pages, i), kv_layer(v_pages, i),
-                                       slot_mapping, cos, sin, pos2d, attn_apply, mods,
-                                       _is_moe_layer(cfg, i))
-        k_pages = kv_set_layer(k_pages, i, kp)
-        v_pages = kv_set_layer(v_pages, i, vp)
-
+    x, k_pages, v_pages = _run_stack(cfg, params, x, k_pages, v_pages, block_tables, ctx_lens,
+                                     slot_mapping, positions[None], mixed=True, n_dec=0,
+                                     chunk=chunk, interpret=interpret, mesh=mesh, tp=tp,
+                                     tp_ctx=tp_ctx)
     # unembed every flat position: (T, 1, d) rows through the module's
     # (batch, seq) contract — T is small (rows x (K+1)), so the full
     # (T, V) logit block stays cheap and the acceptance math runs in-graph
@@ -298,7 +373,7 @@ def _stamp_cost_meta(fn, **meta):
 
 def make_spec_verify_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1, *,
                         chunk: int, do_sample: bool = False, temperature: float = 1.0,
-                        top_k: int = 0, top_p: float = 1.0):
+                        top_k: int = 0, top_p: float = 1.0, tp_ctx: Optional[TPContext] = None):
     """Jitted single-dispatch K-token verify (speculative decoding).
 
     One program per (chunk, sampling) signature: the verify forward
@@ -313,7 +388,8 @@ def make_spec_verify_fn(cfg: TransformerConfig, interpret: bool = False, mesh=No
     """
     from .spec import select_committed
 
-    fwd = functools.partial(spec_verify_forward, cfg, chunk=chunk, interpret=interpret, mesh=mesh, tp=tp)
+    fwd = functools.partial(spec_verify_forward, cfg, chunk=chunk, interpret=interpret, mesh=mesh,
+                            tp=tp, tp_ctx=tp_ctx)
 
     def verify(params, ids, positions, k_pages, v_pages, block_tables, ctx, slots, n_draft, rng):
         # ids/positions/slots: (T,) flat, T = B * chunk; block_tables (B, P);
@@ -331,18 +407,22 @@ def make_spec_verify_fn(cfg: TransformerConfig, interpret: bool = False, mesh=No
                             kind="spec_verify", chunk=chunk, sampled=do_sample)
 
 
-def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1):
+def make_step_fns(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1,
+                  tp_ctx: Optional[TPContext] = None):
     """Jitted (prefill_fn, decode_fn) with donated page buffers."""
-    prefill = jax.jit(functools.partial(ragged_forward, cfg, decode=False, interpret=interpret, mesh=mesh, tp=tp),
+    prefill = jax.jit(functools.partial(ragged_forward, cfg, decode=False, interpret=interpret,
+                                        mesh=mesh, tp=tp, tp_ctx=tp_ctx),
                       donate_argnums=(3, 4), static_argnames=())
-    decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp),
+    decode = jax.jit(functools.partial(ragged_forward, cfg, decode=True, interpret=interpret,
+                                       mesh=mesh, tp=tp, tp_ctx=tp_ctx),
                      donate_argnums=(3, 4), static_argnames=())
     return (_stamp_cost_meta(prefill, kind="prefill"),
             _stamp_cost_meta(decode, kind="decode"))
 
 
 def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1,
-                  do_sample: bool = False, temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+                  do_sample: bool = False, temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                  tp_ctx: Optional[TPContext] = None):
     """Jitted multi-step fused decode (greedy or sampled).
 
     Runs ``steps`` paged-decode steps entirely on device under one
@@ -361,7 +441,8 @@ def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
     """
     from ..generation import sample_logits
 
-    fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp)
+    fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh,
+                            tp=tp, tp_ctx=tp_ctx)
 
     def burst(params, ids0, positions0, k_pages, v_pages, block_tables, ctx0, slots, last, rng):
         # ids0/positions0 (B, 1); ctx0/last (B,); slots (steps, B)
@@ -383,7 +464,8 @@ def make_burst_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp
 
 def make_fused_step_fn(cfg: TransformerConfig, interpret: bool = False, mesh=None, tp: int = 1, *,
                        n_dec: int, n_pre: int, chunk: int, do_sample: bool = False,
-                       temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0):
+                       temperature: float = 1.0, top_k: int = 0, top_p: float = 1.0,
+                       tp_ctx: Optional[TPContext] = None):
     """ONE dispatched program per scheduler quantum (Dynamic SplitFuse).
 
     The program runs the mixed prefill+decode pass (``fused_forward``),
@@ -404,8 +486,9 @@ def make_fused_step_fn(cfg: TransformerConfig, interpret: bool = False, mesh=Non
     from ..generation import sample_logits
 
     fwd = functools.partial(fused_forward, cfg, n_dec=n_dec, chunk=chunk,
-                            interpret=interpret, mesh=mesh, tp=tp)
-    dec_fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh, tp=tp)
+                            interpret=interpret, mesh=mesh, tp=tp, tp_ctx=tp_ctx)
+    dec_fwd = functools.partial(ragged_forward, cfg, decode=True, interpret=interpret, mesh=mesh,
+                                tp=tp, tp_ctx=tp_ctx)
     n_rows = n_dec + n_pre
 
     def fused(params, ids, positions, k_pages, v_pages, block_tables, ctx, slots0, last_flat,

@@ -100,6 +100,10 @@ def _proj(x, p, spec, dtype):
 # ----------------------------------------------------------------------
 def embedding_tpu(cfg: TransformerConfig, params: Dict[str, Any], input_ids, positions):
     """ref ``implementations/embedding/ragged_embedding.py``."""
+    # explicit clamp: single-device XLA gathers clip out-of-vocab ids, but a
+    # vocab-sharded wte under GSPMD masks them to zero instead — pin the
+    # single-device semantics so tp>1 stays token-identical to tp=1
+    input_ids = jnp.clip(input_ids, 0, params["wte"].shape[0] - 1)
     x = params["wte"][input_ids].astype(cfg.dtype)
     if cfg.embed_scale:  # gemma normalizer
         x = x * jnp.asarray(cfg.d_model**0.5, cfg.dtype)

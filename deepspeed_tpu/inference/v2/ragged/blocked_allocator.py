@@ -43,6 +43,36 @@ RES_INFLIGHT = "inflight"
 RES_HOST = "host"
 
 
+def shard_pool_geometry(num_blocks: int, block_bytes: int, shard_degree: int = 1) -> dict:
+    """Per-shard view of a head-sharded paged pool (tensor-parallel serving,
+    docs/SERVING.md "Tensor-parallel serving").
+
+    Block *ids* are global: one host-side allocator serves every shard and
+    the block table ships replicated, so allocate/retain/release semantics
+    are untouched by TP. Only the *bytes* behind each id split — KV heads
+    shard over the tensor axis, so each chip holds ``block_bytes /
+    shard_degree`` of every block. This helper is the one place that
+    arithmetic lives; residency summaries and tests read it from here.
+    """
+    if shard_degree < 1:
+        raise ValueError(f"shard_degree must be >= 1, got {shard_degree}")
+    if block_bytes % shard_degree:
+        # kv_heads % tp == 0 is enforced at engine construction, and every
+        # pool byte scales with kv_heads, so a remainder means the caller's
+        # geometry is inconsistent — refuse rather than round
+        raise ValueError(f"block_bytes {block_bytes} not divisible by "
+                         f"shard_degree {shard_degree}")
+    per_shard = block_bytes // shard_degree
+    return {
+        "num_blocks": int(num_blocks),
+        "shard_degree": int(shard_degree),
+        "block_bytes_global": int(block_bytes),
+        "block_bytes_per_shard": int(per_shard),
+        "pool_bytes_global": int(num_blocks) * int(block_bytes),
+        "pool_bytes_per_shard": int(num_blocks) * int(per_shard),
+    }
+
+
 class BlockedAllocator:
 
     def __init__(self, num_blocks: int):

@@ -49,6 +49,7 @@ _KNOB_TO_FIELD = {
     "DS_TPU_PREFIX_CACHE": "enable_prefix_cache",
     "DS_TPU_DECODE_BURST": "decode_burst",
     "DS_TPU_MIN_DECODE_BUCKET": "min_decode_bucket",
+    "DS_TPU_TP": "tensor_parallel",
 }
 # engine-dict keys that live on RaggedBatchConfig, not the engine config
 _STATE_FIELDS = ("max_ragged_batch_size", "max_ragged_sequence_count",
@@ -185,8 +186,28 @@ def build_engine_from_session(session: Session, overrides: Optional[Dict] = None
         kv_spill=eng.get("kv_spill"),
         enable_prefix_cache=eng.get("enable_prefix_cache"),
         tensor_parallel=int(eng.get("tensor_parallel", 1)))
+    # topology gate: a journal recorded under TP must be replayed on a
+    # topology that can realize the SAME sharding — a silently different
+    # mesh would diverge token streams with no fingerprint to blame
+    tp = int(cfg.tensor_parallel)
+    n_dev = jax.device_count()
+    if tp > 1 and (n_dev < tp or n_dev % tp):
+        raise RuntimeError(
+            f"journal recorded tensor_parallel={tp} (mesh {eng.get('mesh', '?')}) but "
+            f"{n_dev} local device(s) are available — refusing to replay on a "
+            f"mismatched topology. On CPU, force host devices with "
+            f"XLA_FLAGS=--xla_force_host_platform_device_count={tp}.")
     with _env_overrides(env):
-        return InferenceEngineV2(model, params, cfg)
+        engine = InferenceEngineV2(model, params, cfg)
+    want_sig = eng.get("shard_sig")
+    topo_overridden = ("tensor_parallel" in overrides or "DS_TPU_TP" in env
+                       or "DS_TPU_TP_ALLREDUCE_BITS" in env)
+    if want_sig and not topo_overridden and engine._shard_sig != want_sig:
+        raise RuntimeError(
+            f"rebuilt engine sharding {engine._shard_sig!r} != recorded "
+            f"{want_sig!r} — the replay topology does not reproduce the "
+            f"recorded mesh/allreduce layout")
+    return engine
 
 
 def _drive_sla(engine, session: Session, timing: str = "logical",

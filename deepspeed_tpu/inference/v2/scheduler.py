@@ -76,11 +76,16 @@ class FusedQuantum:
 class RaggedBatchScheduler:
 
     def __init__(self, state: DSStateManager, max_batch_tokens: int = 768, max_sequences: int = 512,
-                 prefill_chunk: int = 512):
+                 prefill_chunk: int = 512, shard_degree: int = 1):
         self._state = state
         self.max_batch_tokens = max_batch_tokens
         self.max_sequences = max_sequences
         self.prefill_chunk = prefill_chunk
+        # tensor-parallel serving is SPMD from the host's point of view: one
+        # scheduler drives every shard with the SAME quantum, so budgets and
+        # block accounting stay in global (unsharded) units. shard_degree is
+        # recorded for introspection only — no budget math may divide by it.
+        self.shard_degree = max(1, int(shard_degree))
         tele = get_telemetry_registry()
         self._m_queue_depth = tele.gauge("sched_queue_depth")
         self._m_step_tokens = tele.gauge("sched_step_tokens")

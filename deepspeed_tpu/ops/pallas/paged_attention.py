@@ -72,6 +72,32 @@ def make_kv_pool(shape: Tuple[int, ...], dtype, kv_quant_bits: int = 0):
     return jnp.zeros(shape, dtype)
 
 
+def kv_pool_shard_spec(pool_or_ndim, axis: str = "tensor"):
+    """PartitionSpec sharding a stacked ``(L, blocks, bs, KVH, D)`` pool
+    over its KV-head axis for tensor-parallel serving: heads split on
+    ``axis``, every other dim (layers, blocks, slots, head_dim) replicated
+    so the block table stays global. Accepts a pool (plain array or the
+    int8 ``(codes, scales)`` pair — NOT supported yet, the engine refuses
+    that combination) or an ndim."""
+    from jax.sharding import PartitionSpec as P
+    ndim = pool_or_ndim if isinstance(pool_or_ndim, int) else \
+        len(kv_pool_shape(pool_or_ndim))
+    spec = [None] * ndim
+    spec[-2] = axis  # the KVH axis
+    return P(*spec)
+
+
+def shard_kv_pool(pool, mesh, axis: str = "tensor"):
+    """Place a pool on ``mesh`` with KV heads sharded over ``axis`` —
+    the head-sharded routing every paged kernel then inherits: each shard's
+    dispatch sees a shard-local KVH slice of the same global block ids, so
+    the kernels need no TP awareness at all (they read KVH off the array)."""
+    from jax.sharding import NamedSharding
+    if isinstance(pool, tuple):  # int8 (codes, scales): gated off upstream
+        raise NotImplementedError("int8 KV pools do not shard over the tensor axis yet")
+    return jax.device_put(pool, NamedSharding(mesh, kv_pool_shard_spec(pool.ndim, axis)))
+
+
 def quantize_kv(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric per-(slot, kv-head) int8: (..., KVH, D) -> codes of the
     same shape + f32 scales (..., KVH). ``quantize_weight_kgroups`` idiom:

@@ -166,6 +166,27 @@ def initialize_mesh(config: Optional[MeshConfig] = None, devices=None, force: bo
     return _TOPOLOGY
 
 
+def serving_mesh(tp: int = 1, devices=None) -> MeshTopology:
+    """The inference-serving mesh: ``tensor=tp`` with every remaining local
+    device on ``data``. One process drives N local devices — CPU CI forces
+    N host devices via ``XLA_FLAGS=--xla_force_host_platform_device_count=N``
+    (tests/conftest.py does this for the whole suite)."""
+    return initialize_mesh(MeshConfig.from_dict({"data": -1, "tensor": int(tp)}),
+                           devices=devices)
+
+
+def mesh_signature(topo: Optional[MeshTopology] = None) -> str:
+    """Compact topology identity for program-cache keys and journal/profile
+    fingerprints: non-trivial axes in mesh order (``mesh[data2,tensor4]``),
+    ``mesh[1]`` for a trivial mesh, ``mesh[none]`` with no mesh at all."""
+    topo = topo if topo is not None else _TOPOLOGY
+    if topo is None:
+        return "mesh[none]"
+    axes = ",".join(f"{a}{topo.axis_sizes[a]}" for a in topo.axis_order
+                    if topo.axis_sizes[a] > 1)
+    return f"mesh[{axes or '1'}]"
+
+
 def get_mesh_topology(required: bool = True) -> Optional[MeshTopology]:
     if _TOPOLOGY is None and required:
         raise RuntimeError("Mesh not initialized — call deepspeed_tpu.initialize() or initialize_mesh() first")

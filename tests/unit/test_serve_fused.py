@@ -153,7 +153,8 @@ class TestFusedProgramCache:
             eng._fused_for(*hot, None)  # LRU touch keeps the hot signature alive
             eng._fused_for(8, 2 ** (i % 6), 16 + 16 * (i // 6), None)
         assert len(eng._fused_fns) <= cap
-        assert hot + (False, 1.0, 0, 1.0) in eng._fused_fns
+        # cache keys end with the engine's shard signature (tp topology)
+        assert hot + (False, 1.0, 0, 1.0) + (eng._shard_sig,) in eng._fused_fns
 
     def test_bucketing(self, fused_setup):
         _, _, engine = fused_setup

@@ -786,7 +786,7 @@ def test_watchdog_timeout_raises_structured_alert():
 _METRIC_PREFIXES = ("train_", "comm_", "infer_", "kv_", "sched_", "spec_",
                     "compile_cache_", "watchdog_", "telemetry_", "health_",
                     "journal_", "replay_", "autotune_")
-_EXTRA_METRICS = {"last_step_completed_unix"}
+_EXTRA_METRICS = {"last_step_completed_unix", "tp_degree"}
 
 
 def test_metric_catalog_matches_docs():

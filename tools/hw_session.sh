@@ -11,7 +11,7 @@
 #   with every later artifact silently missing;
 # - chip windows die early: rungs with ZERO hardware evidence (attn,
 #   attn_d64, longctx, serve_sla, serve_prefix, serve_spec, serve_kvtier,
-#   int8/int4 A/B — never measured on a real chip) run FIRST; re-measures of
+#   serve_tp, int8/int4 A/B — never measured on a real chip) run FIRST; re-measures of
 #   known-good numbers (full ladder, train sweep) spend whatever window
 #   is left.
 cd "$(dirname "$0")/.." || exit 1
@@ -56,9 +56,9 @@ ops_smoke() {
 
 # ---- phase A: never-measured rungs (zero hardware evidence) ----
 i=0
-for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec serve_kvtier; do
+for rung in attn attn_d64 longctx serve_sla serve_prefix serve_spec serve_kvtier serve_tp; do
     i=$((i+1))
-    note "A$i/7 bench rung $rung (never measured on-chip)"
+    note "A$i/8 bench rung $rung (never measured on-chip)"
     case $rung in
         serve*) ops_smoke "$rung" & OPS_SMOKE_PID=$! ;;
         *)      OPS_SMOKE_PID= ;;
