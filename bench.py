@@ -93,6 +93,36 @@ def _perf_extras(rung, acct, dt):
                 "pressure": round(float(hbm.get("pressure", 0.0)), 4)},
     }
 
+
+def _profile_capture_extras(wave, quanta=8):
+    """Exposed-fraction extras from one device-timeline capture
+    (telemetry/profiler.py): arm a one-shot window, run one extra
+    UNTIMED wave through it, parse the per-quantum waterfall. Runs after
+    the timed window and after every metric delta is read, so contracts,
+    frozen hashes and the measured numbers are untouched; any failure
+    degrades to {} rather than killing the rung."""
+    try:
+        import tempfile
+
+        from deepspeed_tpu.telemetry import profiler as prof_mod
+        prof, armed = prof_mod.request_capture(quanta=quanta)
+        if not armed:
+            return {}
+        prof.out_dir = tempfile.mkdtemp(prefix="bench-profile-")
+        wave()
+        summary = prof.finish()
+        if not summary:
+            return {}
+        fr = summary.get("fractions") or {}
+        return {
+            "collective_exposed_fraction": float(fr.get("collective_exposed") or 0.0),
+            "device_busy_fraction": float(fr.get("device_busy") or 0.0),
+            "host_gap_fraction": float(fr.get("host_gap") or 0.0),
+            "profile_quanta": int(summary.get("n_quanta") or 0),
+        }
+    except Exception:
+        return {}
+
 # ---------------------------------------------------------------------------
 # FROZEN BENCH CONTRACT (BASELINE.md "Frozen rung contract")
 #
@@ -444,7 +474,9 @@ def run_serve(jax, jnp, np, cfg_model, n_prompts, prompt_len, new_tokens):
                          "ttft_p50_s": lat["ttft_p50_s"], "ttft_p99_s": lat["ttft_p99_s"],
                          "tpot_p50_s": lat["tpot_p50_s"], "tpot_p99_s": lat["tpot_p99_s"],
                          "queue_time_fraction": lat["queue_time_fraction"],
-                         **_perf_extras("serve", acct, dt)}
+                         **_perf_extras("serve", acct, dt),
+                         **_profile_capture_extras(
+                             lambda: eng.generate(prompts, max_new_tokens=new_tokens))}
 
 
 def run_serve_prefix(jax, jnp, np, cfg_model, platform):
@@ -820,7 +852,7 @@ def run_serve_tp(jax, jnp, np, cfg_model, platform):
         if tp > 1:
             shard = eng.k_pages.addressable_shards[0].data
             kv_shard_frac = shard.nbytes / eng.k_pages.nbytes
-        return {
+        result = {
             "out": out, "tps": n_req * new_toks / dt, "lat": lat,
             "dispatches": int(c_disp.value - d0),
             "allreduce_bytes": int(c_tp_bytes.value - b0),
@@ -828,6 +860,12 @@ def run_serve_tp(jax, jnp, np, cfg_model, platform):
             # tp=1 writes first, tp=2 (the headline run) overwrites
             "perf": _perf_extras("serve_tp", acct, dt),
         }
+        if tp > 1:
+            # device-timeline capture of the sharded engine: one extra
+            # untimed wave, after every counter delta above is read
+            result["profile"] = _profile_capture_extras(
+                lambda: eng.generate(prompts, max_new_tokens=new_toks))
+        return result
 
     tp1 = run(1)
     tp2 = run(2)
@@ -840,6 +878,14 @@ def run_serve_tp(jax, jnp, np, cfg_model, platform):
     assert abs(tp2["kv_shard_frac"] - 0.5) < 1e-9, \
         f"per-shard KV bytes {tp2['kv_shard_frac']:.3f} of global, expected 1/2"
     _EVENT_LATENCY["serve_tp"] = tp2["lat"]
+    # satellite budgets: land the TP traffic/dispatch extras in the perf
+    # snapshot so perf_report/perf_gate diff them against the frozen
+    # baseline (tools/perf_thresholds.json "serve_tp")
+    if "serve_tp" in _PERF_EXTRA:
+        _PERF_EXTRA["serve_tp"]["tp"] = {
+            "allreduce_bytes": tp2["allreduce_bytes"],
+            "dispatches": tp2["dispatches"],
+        }
     return tp2["tps"], {
         "tp_degree": 2,
         "tp_parity": True,
@@ -851,6 +897,7 @@ def run_serve_tp(jax, jnp, np, cfg_model, platform):
         "tp_speedup": round(tp2["tps"] / max(1e-9, tp1["tps"]), 3),
         "ttft_p50_s": tp2["lat"]["ttft_p50_s"], "tpot_p50_s": tp2["lat"]["tpot_p50_s"],
         **tp2["perf"],
+        **tp2.get("profile", {}),
     }
 
 

@@ -340,6 +340,26 @@ declare("DS_TPU_FLIGHT_PROFILE_S", "0", "float",
         "this many seconds following the anomaly (opt-in: tracing is not "
         "free).",
         "telemetry/flight.py")
+declare("DS_TPU_FLIGHT_PROFILE_MAX_MB", "64", "float",
+        "Size bound on a flight capture's post-anomaly profile directory: "
+        "over this many MB the raw trace is dropped (drop-and-count in "
+        "the manifest) and only the parsed waterfall summary survives.",
+        "telemetry/flight.py")
+declare("DS_TPU_PROFILE", "0", "bool",
+        "Arm a one-shot device-timeline capture at engine construction: "
+        "the next DS_TPU_PROFILE_QUANTA serving quanta are wrapped in a "
+        "jax.profiler trace and parsed into a per-quantum waterfall "
+        "(compute / exposed-vs-overlapped collective / transfer / host "
+        "gap).",
+        "telemetry/profiler.py")
+declare("DS_TPU_PROFILE_DIR", "profile_captures", "str",
+        "Directory for device-timeline capture output (raw trace plus "
+        "the parsed summary.json per capture).",
+        "telemetry/profiler.py")
+declare("DS_TPU_PROFILE_QUANTA", "32", "int",
+        "Quanta per device-timeline capture window: the trace stops and "
+        "parses after this many dispatch readback boundaries.",
+        "telemetry/profiler.py")
 declare("DS_TPU_STRAGGLER_X", "4", "float",
         "Straggler detector threshold: flag a rank whose pooled "
         "collective-wait p50 exceeds this multiple of the cross-rank "

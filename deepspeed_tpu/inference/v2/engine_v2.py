@@ -35,6 +35,7 @@ from ...telemetry.health import (HBMPressureDetector, QueueStallDetector,
                                  SLOBurnRateDetector, get_health_monitor)
 from ...telemetry.journal import get_journal
 from ...telemetry.ops_plane import maybe_start_ops_server
+from ...telemetry import profiler as device_profiler
 from ...utils.logging import log_dist, logger
 from ...ops.pallas.paged_attention import make_kv_pool
 from .model_runner import (TPContext, make_burst_fn, make_fused_step_fn,
@@ -249,6 +250,9 @@ class InferenceEngineV2:
         if _rec is not None:
             _rec.register_provider("residency", self._residency_summary)
             _rec.register_provider("jit_cache", self._jit_cache_summary)
+        # device-timeline profiler (telemetry/profiler.py): DS_TPU_PROFILE=1
+        # arms a one-shot per-quantum waterfall capture; unset, one bool read
+        device_profiler.maybe_arm_profiler()
 
         # garbage page for padded-token KV writes (allocator's first pop is 0)
         self._garbage_block = self.state._allocator.allocate(1)[0]
@@ -670,6 +674,7 @@ class InferenceEngineV2:
         if defer:
             out_dev = self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
             self._acct.attribute(useful, B * S)
+            device_profiler.note_quantum("prefill", rows=n, bucket=S, tokens=useful)
             return out_dev
         if return_tokens:
             out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
@@ -678,6 +683,7 @@ class InferenceEngineV2:
         # attribution window closes AFTER the readback: in synchronous
         # paths the wall time covers the device execution
         self._acct.attribute(useful, B * S)
+        device_profiler.note_quantum("prefill", rows=n, bucket=S, tokens=useful)
         return [out[j] for j in range(n)]
 
     def _decode_bucket(self, n: int) -> int:
@@ -753,12 +759,14 @@ class InferenceEngineV2:
         if defer:
             out_dev = self._choose_tokens_dev(logits[:n])  # device (n,) ids, no readback
             self._acct.attribute(n, len(ctx))
+            device_profiler.note_quantum("decode", rows=n)
             return out_dev
         if return_tokens:
             out = self._choose_tokens(logits[:n])  # device argmax/sample, tiny readback
         else:
             out = jax.device_get(logits[:n])  # graft-lint: readback (caller asked for host logits)
         self._acct.attribute(n, len(ctx))
+        device_profiler.note_quantum("decode", rows=n)
         return out
 
     def _burst_steps(self, live: Dict[int, int], remaining: int) -> int:
@@ -810,9 +818,11 @@ class InferenceEngineV2:
         self._account_tp_allreduce(len(ctx) * steps)
         if defer:
             self._acct.attribute(n * steps, len(ctx) * steps)
+            device_profiler.note_quantum("decode_burst", rows=n, steps=steps)
             return toks[:n]  # device (n, steps), no readback
         out = jax.device_get(toks[:n])  # graft-lint: readback (n*steps ints, the burst's one fetch)
         self._acct.attribute(n * steps, len(ctx) * steps)
+        device_profiler.note_quantum("decode_burst", rows=n, steps=steps)
         return out
 
     # ---------------------------------------------------------- fused quantum
@@ -998,6 +1008,7 @@ class InferenceEngineV2:
         # readback (N*steps ints) instead of one tiny transfer per row
         toks_host = None if defer else jax.device_get(toks)  # graft-lint: readback
         self._acct.attribute(real, D * steps + P * S)
+        device_profiler.note_quantum("fused_step", rows=N, tokens=real, steps=steps)
         out: Dict[int, object] = {}
         for j, uid in enumerate(dec_uids):
             out[uid] = toks[j] if defer else toks_host[j]
@@ -1135,6 +1146,7 @@ class InferenceEngineV2:
         self._acct.attribute(n + total_acc, B * chunk)
         self._account_tp_allreduce(B * chunk)
         self._acct.note_spec(total_prop, total_acc)
+        device_profiler.note_quantum("spec_verify", rows=n, accepted=total_acc)
         self._m_decode_tokens.inc(n + total_acc)
         self._m_spec_proposed.inc(total_prop)
         self._m_spec_accepted.inc(total_acc)

@@ -28,6 +28,9 @@ from .flight import (FlightRecorder, get_flight_recorder,
 from .journal import (Journal, Session, get_journal, journal_override,
                       read_journal, set_journal)
 from .ops_plane import OpsServer, get_ops_server, maybe_start_ops_server
+from .profiler import (DeviceProfiler, build_waterfall, get_device_profiler,
+                       maybe_arm_profiler, parse_trace_events,
+                       request_capture, summarize_trace_dir)
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "DEFAULT_BUCKETS",
@@ -46,6 +49,9 @@ __all__ = [
     "resolved_knobs", "OpsServer", "get_ops_server", "maybe_start_ops_server",
     "Journal", "Session", "get_journal", "set_journal", "journal_override",
     "read_journal",
+    "DeviceProfiler", "get_device_profiler", "maybe_arm_profiler",
+    "request_capture", "parse_trace_events", "build_waterfall",
+    "summarize_trace_dir",
 ]
 
 

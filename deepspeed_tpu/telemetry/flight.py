@@ -14,7 +14,11 @@ operator needs for a post-mortem into a bounded on-disk capture ring under
 - the resolved knob registry — the exact configuration that produced the
   anomaly,
 - optionally (``DS_TPU_FLIGHT_PROFILE_S>0``) a ``jax.profiler`` trace of
-  the next few seconds, so the quanta *after* the anomaly are profiled.
+  the next few seconds, so the quanta *after* the anomaly are profiled;
+  when the window closes the trace is parsed into a waterfall summary and
+  linked from the manifest's ``profile`` section (relative ``dir``), with
+  the raw directory size-bounded by ``DS_TPU_FLIGHT_PROFILE_MAX_MB``
+  (dropped-and-counted on overflow — the summary always survives).
 
 Captures are directories ``capture-<seq>-<reason>/manifest.json``
 (+ ``profile/``), written to a temp name and renamed so readers (the ops
@@ -197,7 +201,12 @@ class FlightRecorder:
 
     # ----------------------------------------------------------- profile
     def _start_profile(self, capture_dir: str) -> None:
-        """Opt-in post-anomaly trace window; at most one at a time."""
+        """Opt-in post-anomaly trace window; at most one at a time. When
+        the timer stops the trace, the raw profile directory is parsed
+        into a per-quantum waterfall summary (telemetry/profiler.py),
+        size-bounded by ``DS_TPU_FLIGHT_PROFILE_MAX_MB`` (drop-and-count
+        on overflow), and linked from ``manifest.json`` by relative path
+        — a capture is never left holding an unreferenced trace dir."""
         with self._lock:
             if self._profiling:
                 return
@@ -216,12 +225,46 @@ class FlightRecorder:
                 jax.profiler.stop_trace()
             except Exception:
                 pass
+            try:
+                self._finish_profile(capture_dir)
+            except Exception:
+                pass
             with self._lock:
                 self._profiling = False
 
         t = threading.Timer(self.profile_s, _stop)
         t.daemon = True
         t.start()
+
+    def _finish_profile(self, capture_dir: str) -> None:
+        """Summarise + bound the landed trace and link it in the manifest."""
+        from .profiler import dir_bytes, summarize_trace_dir
+        profile_dir = os.path.join(capture_dir, "profile")
+        max_bytes = int(knobs.get_float("DS_TPU_FLIGHT_PROFILE_MAX_MB")
+                        * (1 << 20))
+        section: Dict = {"window_s": self.profile_s, "max_bytes": max_bytes}
+        nbytes = dir_bytes(profile_dir) if os.path.isdir(profile_dir) else 0
+        section["summary"] = _safe(
+            lambda: summarize_trace_dir(profile_dir, window_s=self.profile_s))
+        if nbytes > max_bytes:
+            # over budget: keep the parsed summary, drop the raw trace
+            shutil.rmtree(profile_dir, ignore_errors=True)
+            section.update(dir=None, bytes=nbytes, dropped=True)
+        else:
+            section.update(dir="profile" if nbytes else None,
+                           bytes=nbytes, dropped=False)
+        path = os.path.join(capture_dir, "manifest.json")
+        with self._lock:
+            try:
+                with open(path) as f:
+                    manifest = json.load(f)
+            except (OSError, ValueError):
+                return  # capture already evicted
+            manifest["profile"] = section
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(manifest, f, indent=2, sort_keys=True, default=str)
+            os.replace(tmp, path)
 
     # ----------------------------------------------------------- reading
     def captures(self) -> List[Dict]:

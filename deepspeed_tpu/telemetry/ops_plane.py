@@ -18,6 +18,10 @@ an operable surface: set ``DS_TPU_OPS_PORT`` and a daemon-threaded
                       fetches one manifest
 ``POST /flight/capture``  manual black-box capture (optional JSON body
                       ``{"reason": ...}``)
+``GET /profile``      device-timeline profiler status + last per-quantum
+                      waterfall summary (telemetry/profiler.py)
+``POST /profile/capture``  arm a one-shot device-timeline capture
+                      (optional JSON body ``{"quanta": N}``)
 ``GET /varz``         resolved knob registry from ``analysis/knobs.py``
 ====================  =====================================================
 
@@ -43,7 +47,8 @@ MAX_TIMELINE_EVENTS = 2048  # /requests/<uid>: events across its timelines
 
 _ENDPOINTS = ("/metrics", "/healthz", "/requests", "/requests/<uid>",
               "/perf", "/journal", "/flight", "/flight/<name>",
-              "/flight/capture (POST)", "/varz")
+              "/flight/capture (POST)", "/profile",
+              "/profile/capture (POST)", "/varz")
 
 
 def _json_body(payload, status: int = 200) -> Tuple[int, str, bytes]:
@@ -66,6 +71,8 @@ class OpsPlane:
         if method == "POST":
             if path == "/flight/capture":
                 return self._flight_capture(body)
+            if path == "/profile/capture":
+                return self._profile_capture(body)
             return _json_body({"error": "method not allowed"}, 405)
         if path == "/":
             return _json_body({"service": "deepspeed_tpu ops plane",
@@ -84,6 +91,8 @@ class OpsPlane:
             return self._journal()
         if path == "/varz":
             return self._varz()
+        if path == "/profile":
+            return self._profile()
         if path == "/flight":
             return self._flight_list()
         if path.startswith("/flight/"):
@@ -192,6 +201,37 @@ class OpsPlane:
         if manifest is None:
             return _json_body({"error": f"no capture {name!r}"}, 404)
         return _json_body(manifest)
+
+    def _profile(self) -> Tuple[int, str, bytes]:
+        from .agg import rank_stamp
+        from .profiler import get_device_profiler
+        prof = get_device_profiler()
+        if prof is None:
+            return _json_body({"configured": False, "rank": rank_stamp()})
+        payload = {"configured": True, "rank": rank_stamp(),
+                   **prof.status()}
+        summary = prof.summary()
+        if summary is not None:
+            # the stored summary is already bounded (MAX_QUANTA_ROWS,
+            # top-N programs); _json_body enforces the byte ceiling
+            payload["summary"] = summary
+        return _json_body(payload)
+
+    def _profile_capture(self, body: bytes) -> Tuple[int, str, bytes]:
+        from .profiler import request_capture
+        quanta = None
+        if body:
+            try:
+                quanta = json.loads(body.decode()).get("quanta")
+                quanta = int(quanta) if quanta is not None else None
+            except (ValueError, AttributeError, TypeError):
+                return _json_body({"error": "bad JSON body"}, 400)
+        prof, armed = request_capture(quanta)
+        status = prof.status()
+        if not armed:
+            return _json_body({"error": "capture already tracing",
+                               **status}, 409)
+        return _json_body({"armed": True, **status}, 201)
 
     def _flight_capture(self, body: bytes) -> Tuple[int, str, bytes]:
         from .flight import get_flight_recorder

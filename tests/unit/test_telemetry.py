@@ -786,7 +786,13 @@ def test_watchdog_timeout_raises_structured_alert():
 _METRIC_PREFIXES = ("train_", "comm_", "infer_", "kv_", "sched_", "spec_",
                     "compile_cache_", "watchdog_", "telemetry_", "health_",
                     "journal_", "replay_", "autotune_")
-_EXTRA_METRICS = {"last_step_completed_unix", "tp_degree"}
+# profile_* metrics are listed explicitly: a bare "profile_" prefix would
+# also match the `profile_captures` knob-default directory name in docs
+_EXTRA_METRICS = {"last_step_completed_unix", "tp_degree",
+                  "profile_captures_total",
+                  "profile_collective_exposed_fraction",
+                  "profile_device_busy_fraction",
+                  "profile_host_gap_fraction"}
 
 
 def test_metric_catalog_matches_docs():

@@ -85,6 +85,18 @@ timeout 900 python tools/autotune_serve.py smoke --dir autotune_smoke >> "$LOG" 
 note "autotune smoke rc=$?"
 probe
 
+# archive one real-chip device-timeline capture of the sharded serving
+# path: serve_tp runs with DS_TPU_PROFILE armed, landing the raw trace +
+# parsed per-quantum waterfall under profile_captures/; the rendered
+# report (collective exposed vs overlapped, host gap) goes in the log
+note "A7.7 serve_tp device-timeline capture (profile_captures/)"
+DS_TPU_PROFILE=1 DS_TPU_PROFILE_DIR=profile_captures DS_TPU_PROFILE_QUANTA=16 \
+    DS_BENCH_EXTRA=0 DS_BENCH_RUNG=serve_tp timeout 1800 python bench.py >> "$LOG" 2>&1
+note "serve_tp profile capture rc=$?"
+timeout 120 python tools/trace_report.py profile_captures >> "$LOG" 2>&1
+note "trace report rc=$?"
+probe
+
 # archive one manual flight capture per session: the black box of a
 # healthy run is the baseline a post-mortem diff needs
 note "manual flight capture (session baseline)"
@@ -125,4 +137,4 @@ note "train sweep rc=$?"
 probe
 
 python tools/hw_summary.py > HW_SUMMARY.txt 2>&1
-note "session complete - artifacts: BENCH_extra.json + BENCH_SLA.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + ops_*_{healthz,perf}.json + flight_captures/ + $LOG"
+note "session complete - artifacts: BENCH_extra.json + BENCH_SLA.json + TRAIN_SWEEP.jsonl + HW_SUMMARY.txt + ops_*_{healthz,perf}.json + flight_captures/ + profile_captures/ + $LOG"

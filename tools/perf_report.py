@@ -150,21 +150,32 @@ def render(doc, rung=None):
 
 # headline metric -> direction: +1 = higher is better, -1 = lower is better
 HEADLINE_METRICS = (("tokens_per_sec", +1), ("mfu", +1),
-                    ("goodput_fraction", +1), ("dispatches", -1))
+                    ("goodput_fraction", +1), ("dispatches", -1),
+                    ("allreduce_bytes", -1))
 
 
 def snapshot_headline(snap):
-    """The comparable scalars of one rung's snapshot."""
+    """The comparable scalars of one rung's snapshot. Snapshots from a
+    tensor-parallel rung carry a ``tp`` section (bench.py run_serve_tp)
+    whose allreduce traffic and headline-run dispatch count override the
+    process-wide card sum — a quantized-allreduce or dispatch-count
+    regression then fails perf_gate, not just eyeballs."""
     totals = snap.get("totals") or {}
     ledger = snap.get("ledger") or {}
+    tp = snap.get("tp") or {}
     time_s = float(totals.get("time_s") or 0.0)
     useful = float(totals.get("useful_tokens") or 0.0)
-    return {
+    out = {
         "tokens_per_sec": useful / time_s if time_s > 0 else 0.0,
         "mfu": snap.get("mfu"),
         "goodput_fraction": float(ledger.get("goodput_fraction") or 0.0),
         "dispatches": float(sum(int(c.get("calls", 0)) for c in snap.get("cards") or [])),
     }
+    if "allreduce_bytes" in tp:
+        out["allreduce_bytes"] = float(tp["allreduce_bytes"])
+    if "dispatches" in tp:
+        out["dispatches"] = float(tp["dispatches"])
+    return out
 
 
 def diff_rows(head_a, head_b, threshold):

@@ -8,14 +8,22 @@ cross-rank view — counters summed, fixed-bucket histograms merged,
 gauges maxed with a per-rank breakdown — and flags collective-wait
 stragglers (a rank whose pooled ``comm_latency_seconds`` p50 exceeds
 ``--ratio`` x the cross-rank median; the same analysis the
-``StragglerDetector`` runs in-process). See docs/OBSERVABILITY.md
-"Ops plane & flight recorder".
+``StragglerDetector`` runs in-process). Per-rank device-timeline
+profiler summaries (``profile-rank<k>.json``, written by
+``DeviceProfiler.write_rank_summary``) found next to the snapshots are
+merged alongside: the merged document carries each rank's
+exposed-collective / device-busy / host-gap fractions. See
+docs/OBSERVABILITY.md "Ops plane & flight recorder" and "Device
+timeline & collective exposure".
 
 Usage:
     python tools/telemetry_merge.py <dir-or-files...> [-o merged.json]
-        [--ratio 4.0] [--min-count 8]
+        [--ratio 4.0] [--min-count 8] [--json]
 
-Exit code 2 when a straggler is flagged (scriptable in session tooling).
+``--json`` prints a machine-readable verdict document to stdout —
+straggler verdict plus per-rank exposed-collective fractions — instead
+of the full merged snapshot. Exit code 2 when a straggler is flagged
+(scriptable in session tooling).
 """
 
 import argparse
@@ -28,31 +36,67 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def _expand(paths):
-    out = []
+    """Split inputs into (snapshot files, profiler summary files).
+    Directories contribute both globs; explicit files are classified by
+    basename."""
+    snaps, profiles = [], []
     for p in paths:
         if os.path.isdir(p):
-            out.extend(sorted(glob.glob(os.path.join(p, "telemetry-rank*.json"))))
+            snaps.extend(sorted(glob.glob(os.path.join(p, "telemetry-rank*.json"))))
+            profiles.extend(sorted(glob.glob(os.path.join(p, "profile-rank*.json"))))
+        elif os.path.basename(p).startswith("profile-rank"):
+            profiles.append(p)
         else:
-            out.append(p)
+            snaps.append(p)
+    return snaps, profiles
+
+
+def _merge_profiles(files):
+    """Per-rank waterfall fractions from profiler summary files; a
+    malformed file records an error string instead of killing the merge."""
+    out = {}
+    for path in files:
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+            rank = (doc.get("rank") or {}).get("process_index")
+            if rank is None:  # fall back to the filename's rank digits
+                rank = int("".join(c for c in os.path.basename(path)
+                                   if c.isdigit()) or 0)
+            summary = doc.get("summary") or {}
+            fr = summary.get("fractions") or {}
+            out[str(rank)] = {
+                "collective_exposed_fraction": fr.get("collective_exposed"),
+                "device_busy_fraction": fr.get("device_busy"),
+                "host_gap_fraction": fr.get("host_gap"),
+                "n_quanta": summary.get("n_quanta"),
+                "trace": summary.get("trace"),
+            }
+        except (OSError, ValueError) as e:
+            out[os.path.basename(path)] = {"error": f"{type(e).__name__}: {e}"}
     return out
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="+",
-                    help="snapshot files, or directories holding telemetry-rank*.json")
+                    help="snapshot files, or directories holding "
+                         "telemetry-rank*.json (+ profile-rank*.json)")
     ap.add_argument("-o", "--out", default=None,
                     help="write the merged snapshot JSON here (default: stdout)")
     ap.add_argument("--ratio", type=float, default=None,
                     help="straggler threshold multiple (default: DS_TPU_STRAGGLER_X)")
     ap.add_argument("--min-count", type=int, default=8,
                     help="minimum recorded collectives for a rank to be judged")
+    ap.add_argument("--json", action="store_true",
+                    help="print the straggler verdict + per-rank "
+                         "exposed-collective fractions as JSON")
     args = ap.parse_args(argv)
 
     from deepspeed_tpu.analysis import knobs
     from deepspeed_tpu.telemetry.agg import detect_stragglers, merge_snapshots
 
-    files = _expand(args.paths)
+    files, profile_files = _expand(args.paths)
     if not files:
         print("telemetry_merge: no snapshot files found", file=sys.stderr)
         return 1
@@ -65,6 +109,9 @@ def main(argv=None) -> int:
     ratio = args.ratio if args.ratio is not None else knobs.get_float("DS_TPU_STRAGGLER_X")
     report = detect_stragglers(snaps, ratio=ratio, min_count=args.min_count)
     merged["straggler_report"] = report
+    profiles = _merge_profiles(profile_files)
+    if profiles:
+        merged["profiles"] = profiles
 
     text = json.dumps(merged, indent=2, sort_keys=True)
     if args.out:
@@ -72,8 +119,16 @@ def main(argv=None) -> int:
             f.write(text + "\n")
         print(f"telemetry_merge: wrote {args.out} ({len(files)} ranks)",
               file=sys.stderr)
-    else:
+    elif not args.json:
         print(text)
+    if args.json:
+        verdict = {
+            "verdict": "straggler" if report["stragglers"] else "clean",
+            "straggler_report": report,
+            "ranks": len(files),
+            "profiles": profiles,
+        }
+        print(json.dumps(verdict, indent=2, sort_keys=True))
 
     for s in report["stragglers"]:
         print(f"telemetry_merge: STRAGGLER rank {s['rank']}: collective-wait "
